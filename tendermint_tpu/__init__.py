@@ -1,0 +1,11 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication framework.
+
+Re-implements the capabilities of Tendermint Core v0.34.0 (the reference at
+/root/reference) with a TPU-first design: the host side is an asyncio event-loop
+state machine, and every O(validators) cryptographic workload (vote/commit
+signature verification) is batched through JAX/XLA kernels over the validator
+axis instead of the reference's serial per-signature loop
+(reference: types/validator_set.go:680-702).
+"""
+
+__version__ = "0.1.0"

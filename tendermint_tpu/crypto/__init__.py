@@ -1,0 +1,8 @@
+from tendermint_tpu.crypto.keys import (  # noqa: F401
+    PrivKey,
+    PubKey,
+    Ed25519PrivKey,
+    Ed25519PubKey,
+    address_from_pubkey_bytes,
+    gen_ed25519,
+)

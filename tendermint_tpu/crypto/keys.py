@@ -1,0 +1,142 @@
+"""Key interfaces and the host-side Ed25519 implementation.
+
+Mirrors the reference's crypto.PubKey/PrivKey interfaces (reference:
+crypto/crypto.go:22,30): addresses are the first 20 bytes of SHA-256 of the raw
+public key bytes. Host-side sign/verify rides the `cryptography` package
+(OpenSSL, constant-time); the batched TPU path lives in
+tendermint_tpu.crypto.batch / tendermint_tpu.ops.ed25519_jax.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives import serialization
+
+from tendermint_tpu.crypto import tmhash
+
+ED25519_KEY_TYPE = "ed25519"
+SR25519_KEY_TYPE = "sr25519"
+
+PUBKEY_SIZE = 32
+PRIVKEY_SIZE = 32  # seed
+SIGNATURE_SIZE = 64
+ADDRESS_SIZE = tmhash.TRUNCATED_SIZE
+
+
+def address_from_pubkey_bytes(pubkey_bytes: bytes) -> bytes:
+    return tmhash.sum_truncated(pubkey_bytes)
+
+
+class PubKey:
+    """Public key interface: address(), bytes(), verify(), type_name()."""
+
+    def address(self) -> bytes:
+        raise NotImplementedError
+
+    def bytes(self) -> bytes:
+        raise NotImplementedError
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        raise NotImplementedError
+
+    def type_name(self) -> str:
+        raise NotImplementedError
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, PubKey)
+            and self.type_name() == other.type_name()
+            and self.bytes() == other.bytes()
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.type_name(), self.bytes()))
+
+
+class PrivKey:
+    def bytes(self) -> bytes:
+        raise NotImplementedError
+
+    def sign(self, msg: bytes) -> bytes:
+        raise NotImplementedError
+
+    def pub_key(self) -> PubKey:
+        raise NotImplementedError
+
+    def type_name(self) -> str:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class Ed25519PubKey(PubKey):
+    key_bytes: bytes
+
+    def __post_init__(self):
+        if len(self.key_bytes) != PUBKEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUBKEY_SIZE} bytes")
+
+    def address(self) -> bytes:
+        return address_from_pubkey_bytes(self.key_bytes)
+
+    def bytes(self) -> bytes:
+        return self.key_bytes
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        try:
+            Ed25519PublicKey.from_public_bytes(self.key_bytes).verify(sig, msg)
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    def type_name(self) -> str:
+        return ED25519_KEY_TYPE
+
+    def __hash__(self) -> int:
+        return hash((ED25519_KEY_TYPE, self.key_bytes))
+
+
+@dataclass(frozen=True, repr=False)
+class Ed25519PrivKey(PrivKey):
+    seed: bytes
+
+    def __repr__(self) -> str:  # never print private key material
+        return "Ed25519PrivKey(<redacted>)"
+
+    def __post_init__(self):
+        if len(self.seed) != PRIVKEY_SIZE:
+            raise ValueError(f"ed25519 privkey seed must be {PRIVKEY_SIZE} bytes")
+
+    def bytes(self) -> bytes:
+        return self.seed
+
+    def sign(self, msg: bytes) -> bytes:
+        return Ed25519PrivateKey.from_private_bytes(self.seed).sign(msg)
+
+    def pub_key(self) -> Ed25519PubKey:
+        pub = Ed25519PrivateKey.from_private_bytes(self.seed).public_key()
+        raw = pub.public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        return Ed25519PubKey(raw)
+
+    def type_name(self) -> str:
+        return ED25519_KEY_TYPE
+
+
+def gen_ed25519(seed: bytes | None = None) -> Ed25519PrivKey:
+    return Ed25519PrivKey(seed if seed is not None else os.urandom(PRIVKEY_SIZE))
+
+
+def pubkey_from_type_and_bytes(type_name: str, data: bytes) -> PubKey:
+    if type_name == ED25519_KEY_TYPE:
+        return Ed25519PubKey(data)
+    raise ValueError(f"unknown pubkey type {type_name!r}")

@@ -1,0 +1,14 @@
+"""Test configuration.
+
+Must run before jax initializes: force the CPU platform with 8 virtual devices
+so multi-chip sharding paths (jax.sharding.Mesh over 8 devices) are exercised
+without TPU hardware. Real-TPU benchmarking goes through bench.py, which does
+not import this file.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,95 @@
+"""Host crypto: ed25519 (cryptography ↔ pure-python RFC 8032 cross-check), addresses."""
+
+import os
+
+import pytest
+
+from tendermint_tpu.crypto import (
+    Ed25519PrivKey,
+    Ed25519PubKey,
+    gen_ed25519,
+)
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.crypto import tmhash
+
+
+def test_rfc8032_test_vector_1():
+    # RFC 8032 §7.1 TEST 1 (empty message)
+    seed = bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+    )
+    pub = bytes.fromhex(
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+    )
+    sig = bytes.fromhex(
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+    )
+    assert ref.public_key(seed) == pub
+    assert ref.sign(seed, b"") == sig
+    assert ref.verify(pub, b"", sig)
+    assert not ref.verify(pub, b"x", sig)
+
+
+def test_rfc8032_test_vector_2():
+    seed = bytes.fromhex(
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb"
+    )
+    pub = bytes.fromhex(
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c"
+    )
+    msg = bytes.fromhex("72")
+    sig = bytes.fromhex(
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"
+    )
+    assert ref.public_key(seed) == pub
+    assert ref.sign(seed, msg) == sig
+    assert ref.verify(pub, msg, sig)
+
+
+def test_host_and_ref_agree():
+    for i in range(8):
+        seed = bytes([i]) * 32
+        priv = Ed25519PrivKey(seed)
+        msg = b"payload-%d" % i
+        sig = priv.sign(msg)
+        # Same keypair derivation and signature as the pure-python reference
+        assert priv.pub_key().bytes() == ref.public_key(seed)
+        assert sig == ref.sign(seed, msg)
+        # Cross-verify both directions
+        assert priv.pub_key().verify(msg, sig)
+        assert ref.verify(priv.pub_key().bytes(), msg, sig)
+
+
+def test_verify_rejects():
+    priv = gen_ed25519(b"\x07" * 32)
+    pub = priv.pub_key()
+    sig = priv.sign(b"msg")
+    assert pub.verify(b"msg", sig)
+    bad = bytearray(sig)
+    bad[0] ^= 1
+    assert not pub.verify(b"msg", bytes(bad))
+    assert not pub.verify(b"other", sig)
+    assert not pub.verify(b"msg", sig[:-1])
+    # s >= L must be rejected (malleability)
+    s_high = sig[:32] + (ref.L).to_bytes(32, "little")
+    assert not ref.verify(pub.bytes(), b"msg", s_high)
+
+
+def test_address():
+    priv = gen_ed25519(b"\x01" * 32)
+    pub = priv.pub_key()
+    assert pub.address() == tmhash.sum_truncated(pub.bytes())
+    assert len(pub.address()) == 20
+
+
+def test_pubkey_equality_and_bad_sizes():
+    a = gen_ed25519(b"\x02" * 32).pub_key()
+    b = gen_ed25519(b"\x02" * 32).pub_key()
+    c = gen_ed25519(b"\x03" * 32).pub_key()
+    assert a == b and a != c
+    with pytest.raises(ValueError):
+        Ed25519PubKey(b"short")
+    with pytest.raises(ValueError):
+        Ed25519PrivKey(b"short")

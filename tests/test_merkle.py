@@ -1,0 +1,60 @@
+"""Merkle: RFC6962 golden vectors + proof round-trips."""
+
+import hashlib
+
+from tendermint_tpu.crypto import merkle
+
+
+def test_empty_tree():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+
+def test_single_leaf():
+    item = b"hello"
+    assert merkle.hash_from_byte_slices([item]) == hashlib.sha256(b"\x00" + item).digest()
+
+
+def test_two_leaves():
+    a, b = b"a", b"b"
+    la = hashlib.sha256(b"\x00" + a).digest()
+    lb = hashlib.sha256(b"\x00" + b).digest()
+    expect = hashlib.sha256(b"\x01" + la + lb).digest()
+    assert merkle.hash_from_byte_slices([a, b]) == expect
+
+
+def test_split_point():
+    assert merkle.split_point(2) == 1
+    assert merkle.split_point(3) == 2
+    assert merkle.split_point(4) == 2
+    assert merkle.split_point(5) == 4
+    assert merkle.split_point(8) == 4
+    assert merkle.split_point(9) == 8
+
+
+def test_rfc6962_structure_five_leaves():
+    items = [bytes([i]) for i in range(5)]
+    left = merkle.hash_from_byte_slices(items[:4])
+    right = merkle.hash_from_byte_slices(items[4:])
+    expect = hashlib.sha256(b"\x01" + left + right).digest()
+    assert merkle.hash_from_byte_slices(items) == expect
+
+
+def test_proofs_verify():
+    for n in [1, 2, 3, 5, 8, 13, 64]:
+        items = [b"item-%d" % i for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, proof in enumerate(proofs):
+            assert proof.total == n and proof.index == i
+            assert proof.verify(root, items[i])
+            # wrong leaf / wrong root fail
+            assert not proof.verify(root, b"bogus")
+            assert not proof.verify(b"\x00" * 32, items[i])
+
+
+def test_proof_wrong_index_fails():
+    items = [b"a", b"b", b"c", b"d"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    p = proofs[0]
+    p.index = 1
+    assert not p.verify(root, items[0])

@@ -1,0 +1,139 @@
+"""protowire must agree byte-for-byte with the google.protobuf runtime."""
+
+import struct
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+from tendermint_tpu.libs import protowire as pw
+
+
+def _make_dynamic_message_cls():
+    """Build a dynamic proto message equivalent to CanonicalVote via descriptors."""
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "test_canonical.proto"
+    fdp.package = "testpkg"
+    fdp.syntax = "proto3"
+
+    ts = fdp.message_type.add()
+    ts.name = "Ts"
+    f = ts.field.add()
+    f.name = "seconds"
+    f.number = 1
+    f.type = descriptor_pb2.FieldDescriptorProto.TYPE_INT64
+    f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+    f = ts.field.add()
+    f.name = "nanos"
+    f.number = 2
+    f.type = descriptor_pb2.FieldDescriptorProto.TYPE_INT32
+    f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+    m = fdp.message_type.add()
+    m.name = "CanonicalVoteLike"
+    specs = [
+        ("type", 1, descriptor_pb2.FieldDescriptorProto.TYPE_INT64),
+        ("height", 2, descriptor_pb2.FieldDescriptorProto.TYPE_SFIXED64),
+        ("round", 3, descriptor_pb2.FieldDescriptorProto.TYPE_SFIXED64),
+        ("hash", 4, descriptor_pb2.FieldDescriptorProto.TYPE_BYTES),
+        ("chain_id", 6, descriptor_pb2.FieldDescriptorProto.TYPE_STRING),
+    ]
+    for name, num, typ in specs:
+        f = m.field.add()
+        f.name = name
+        f.number = num
+        f.type = typ
+        f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+    f = m.field.add()
+    f.name = "timestamp"
+    f.number = 5
+    f.type = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+    f.type_name = ".testpkg.Ts"
+    f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fdp)
+    desc = pool.FindMessageTypeByName("testpkg.CanonicalVoteLike")
+    ts_desc = pool.FindMessageTypeByName("testpkg.Ts")
+    return (
+        message_factory.GetMessageClass(desc),
+        message_factory.GetMessageClass(ts_desc),
+    )
+
+
+def test_varint_roundtrip():
+    for v in [0, 1, 127, 128, 300, 2**32, 2**63 - 1, -1, -5]:
+        enc = pw.encode_varint(v)
+        dec, pos = pw.decode_varint(enc)
+        assert pos == len(enc)
+        if v >= 0:
+            assert dec == v
+        else:
+            assert dec == v + (1 << 64)
+
+
+def test_against_protobuf_runtime():
+    VoteCls, TsCls = _make_dynamic_message_cls()
+
+    msg = VoteCls()
+    msg.type = 1
+    msg.height = 12345
+    msg.round = 2
+    msg.hash = b"\xaa" * 32
+    msg.chain_id = "test-chain"
+    msg.timestamp.seconds = 1700000000
+    msg.timestamp.nanos = 123456789
+    expected = msg.SerializeToString(deterministic=True)
+
+    w = pw.Writer()
+    w.varint_field(1, 1)
+    w.sfixed64_field(2, 12345)
+    w.sfixed64_field(3, 2)
+    w.bytes_field(4, b"\xaa" * 32)
+    w.message_field(5, pw.encode_timestamp(1700000000, 123456789), always=True)
+    w.string_field(6, "test-chain")
+    assert w.bytes() == expected
+
+
+def test_zero_fields_omitted_matches_proto3():
+    VoteCls, _ = _make_dynamic_message_cls()
+    msg = VoteCls()
+    msg.timestamp.seconds = 5  # force presence of the submessage
+    expected = msg.SerializeToString(deterministic=True)
+
+    w = pw.Writer()
+    w.varint_field(1, 0)
+    w.sfixed64_field(2, 0)
+    w.sfixed64_field(3, 0)
+    w.bytes_field(4, b"")
+    w.message_field(5, pw.encode_timestamp(5, 0), always=True)
+    w.string_field(6, "")
+    assert w.bytes() == expected
+
+
+def test_negative_varint_is_10_bytes():
+    assert len(pw.encode_varint(-1)) == 10
+
+
+def test_sfixed64_encoding():
+    w = pw.Writer()
+    w.sfixed64_field(2, -7)
+    got = w.bytes()
+    assert got[0] == (2 << 3) | 1
+    assert struct.unpack("<q", got[1:9])[0] == -7
+
+
+def test_length_delimited_roundtrip():
+    body = b"hello world"
+    framed = pw.length_delimited(body)
+    out, pos = pw.read_length_delimited(framed)
+    assert out == body and pos == len(framed)
+
+
+def test_reader_roundtrip():
+    w = pw.Writer()
+    w.varint_field(1, 42)
+    w.sfixed64_field(2, -1)
+    w.bytes_field(3, b"xyz")
+    fields = list(pw.Reader(w.bytes()))
+    assert fields[0] == (1, pw.VARINT, 42)
+    assert fields[1][0] == 2 and pw.sfixed64_from_unsigned(fields[1][2]) == -1
+    assert fields[2] == (3, pw.BYTES, b"xyz")
