@@ -1,0 +1,165 @@
+"""Batch signature verification — the framework's north-star interface.
+
+`verify_batch(pubkeys, msgs, sigs) -> bool mask` with two backends:
+
+- "cpu": serial host loop over OpenSSL (the reference-shaped baseline — this is
+  exactly what the reference does in Go, one VerifySignature per validator,
+  reference: types/validator_set.go:680-702).
+- "jax": the TPU path — host computes h = SHA512(R||A||M) mod L per item
+  (cheap, C-speed hashlib), then one jitted kernel verifies the whole batch on
+  device (tendermint_tpu.ops.ed25519_jax).
+
+Every O(validators) verification site in the framework (VerifyCommit,
+VerifyCommitLight/Trusting, vote storms, fast-sync replay, evidence) funnels
+through this module.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List, Sequence
+
+import numpy as np
+
+from tendermint_tpu.crypto.ed25519_ref import L
+
+_BUCKET_SIZES = [2**i for i in range(17)]  # jit shape buckets: 1..65536
+
+
+def _bucket(n: int) -> int:
+    for b in _BUCKET_SIZES:
+        if n <= b:
+            return b
+    return n
+
+
+def backend_default() -> str:
+    env = os.environ.get("TMTPU_CRYPTO_BACKEND")
+    if env:
+        return env
+    try:
+        import jax  # noqa: F401
+
+        return "jax"
+    except Exception:  # pragma: no cover
+        return "cpu"
+
+
+def verify_batch_cpu(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> np.ndarray:
+    from tendermint_tpu.crypto.keys import Ed25519PubKey
+
+    out = np.zeros(len(pubkeys), dtype=bool)
+    for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
+        try:
+            out[i] = Ed25519PubKey(bytes(pk)).verify(bytes(msg), bytes(sig))
+        except ValueError:
+            out[i] = False
+    return out
+
+
+def _bits_le(vals: np.ndarray, nbits: int) -> np.ndarray:
+    """uint8[N, 32] little-endian scalars -> uint8[nbits, N] LSB-first bits."""
+    bits = np.unpackbits(vals, axis=1, bitorder="little")  # (N, 256)
+    return np.ascontiguousarray(bits[:, :nbits].T)
+
+
+def prepare_batch(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+):
+    """Host-side preprocessing for the device kernel.
+
+    Returns (a_bytes[32,B], r_bytes[32,B], s_bits[253,B], h_bits[253,B],
+    precheck[N] bool, n) with B = padded bucket size.
+    """
+    n = len(pubkeys)
+    b = _bucket(max(n, 1))
+    a = np.zeros((b, 32), dtype=np.uint8)
+    r = np.zeros((b, 32), dtype=np.uint8)
+    s = np.zeros((b, 32), dtype=np.uint8)
+    h = np.zeros((b, 32), dtype=np.uint8)
+    precheck = np.zeros(n, dtype=bool)
+    for i in range(n):
+        pk, msg, sig = bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i])
+        if len(pk) != 32 or len(sig) != 64:
+            continue
+        s_int = int.from_bytes(sig[32:], "little")
+        if s_int >= L:
+            continue  # non-canonical s: reject without device work
+        precheck[i] = True
+        a[i] = np.frombuffer(pk, dtype=np.uint8)
+        r[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+        s[i] = np.frombuffer(sig[32:], dtype=np.uint8)
+        h_int = (
+            int.from_bytes(hashlib.sha512(sig[:32] + pk + msg).digest(), "little") % L
+        )
+        h[i] = np.frombuffer(h_int.to_bytes(32, "little"), dtype=np.uint8)
+    from tendermint_tpu.ops.ed25519_jax import SCALAR_BITS
+
+    return (
+        np.ascontiguousarray(a.T),
+        np.ascontiguousarray(r.T),
+        _bits_le(s, SCALAR_BITS),
+        _bits_le(h, SCALAR_BITS),
+        precheck,
+        n,
+    )
+
+
+def verify_batch_jax(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> np.ndarray:
+    from tendermint_tpu.ops.ed25519_jax import verify_prepared
+
+    a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
+    mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
+    return mask & precheck
+
+
+def verify_batch(
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    backend: str | None = None,
+) -> np.ndarray:
+    """Verify N (pubkey, msg, sig) ed25519 triples; returns bool[N]."""
+    if not (len(pubkeys) == len(msgs) == len(sigs)):
+        raise ValueError("pubkeys/msgs/sigs length mismatch")
+    if len(pubkeys) == 0:
+        return np.zeros(0, dtype=bool)
+    be = backend or backend_default()
+    if be == "cpu":
+        return verify_batch_cpu(pubkeys, msgs, sigs)
+    if be == "jax":
+        return verify_batch_jax(pubkeys, msgs, sigs)
+    raise ValueError(f"unknown crypto backend {be!r}")
+
+
+class Ed25519BatchVerifier:
+    """Accumulate-and-flush batch verifier (the interface the consensus vote
+    path and commit verification use)."""
+
+    def __init__(self, backend: str | None = None) -> None:
+        self._backend = backend
+        self._pubkeys: List[bytes] = []
+        self._msgs: List[bytes] = []
+        self._sigs: List[bytes] = []
+
+    def add(self, pubkey: bytes, msg: bytes, sig: bytes) -> None:
+        self._pubkeys.append(bytes(pubkey))
+        self._msgs.append(bytes(msg))
+        self._sigs.append(bytes(sig))
+
+    def __len__(self) -> int:
+        return len(self._pubkeys)
+
+    def verify(self) -> np.ndarray:
+        """Verify all accumulated triples; the batch stays (call reset())."""
+        return verify_batch(self._pubkeys, self._msgs, self._sigs, self._backend)
+
+    def reset(self) -> None:
+        self._pubkeys.clear()
+        self._msgs.clear()
+        self._sigs.clear()

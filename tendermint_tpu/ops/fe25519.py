@@ -1,0 +1,317 @@
+"""Batched GF(2^255-19) field arithmetic in JAX (uint32 limbs).
+
+TPU-first design notes
+----------------------
+- A field element is `uint32[20, ...batch]`: limbs on the LEADING axis so the
+  batch axis maps onto TPU vector lanes; every op is elementwise across batch.
+- Mixed-radix limbs (donna-style): limb i holds bits [s_i, s_{i+1}) of the
+  value with s_i = ceil(12.75*i), widths alternating 13/13/13/12. The 20 limbs
+  cover exactly 255 bits, so the wrap factor at limb 20 is exactly
+  2^255 ≡ 19 (mod p) — no awkward 2^260-style folds.
+- Schoolbook products: position s_i + s_j differs from s_{i+j} by 0 or 1 bits
+  (superadditivity of ceil), absorbed by a static {1,2} multiplier matrix M.
+  Accumulation bound: sum of ≤20 terms of 2·(2^13+ε)^2 < 2^32 — fits uint32
+  with no wide accumulator, which TPUs don't have.
+- All public ops return "carried" limbs: limb i < 2^{w_i} + 38 (loose bound;
+  value ≡ correct mod p, value < 2^255 + small). `freeze` produces the unique
+  canonical representative for byte encoding / comparison.
+
+This replaces the per-signature scalar curve arithmetic the reference does in
+Go (reference: crypto/ed25519/ed25519.go:148 via golang.org/x/crypto) with a
+validator-axis-parallel implementation.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = 2**255 - 19
+D = (-121665 * pow(121666, P - 2, P)) % P
+D2 = (2 * D) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+NLIMBS = 20
+# Bit positions s_i = ceil(51*i/4) for i in 0..39 (covers product limbs too).
+S = [math.ceil(51 * i / 4) for i in range(2 * NLIMBS + 1)]
+assert S[NLIMBS] == 255
+W = [S[i + 1] - S[i] for i in range(2 * NLIMBS)]  # limb widths (13 or 12)
+for _k in range(NLIMBS, 2 * NLIMBS):
+    assert S[_k] - S[_k - NLIMBS] == 255  # high limbs wrap with factor exactly 19
+
+# M[i, j] = 2^(s_i + s_j - s_{i+j}) in {1, 2}
+_M = np.zeros((NLIMBS, NLIMBS), dtype=np.uint32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        delta = S[_i] + S[_j] - S[_i + _j]
+        assert delta in (0, 1)
+        _M[_i, _j] = 1 << delta
+M = jnp.asarray(_M)
+
+_MASKS = np.array([(1 << w) - 1 for w in W], dtype=np.uint32)
+
+
+def from_int(x: int) -> np.ndarray:
+    """Host-side: python int -> canonical limbs, shape (20,)."""
+    x %= P
+    out = np.zeros(NLIMBS, dtype=np.uint32)
+    for i in range(NLIMBS):
+        out[i] = (x >> S[i]) & ((1 << W[i]) - 1)
+    return out
+
+
+def to_int(limbs) -> int:
+    """Host-side: limbs -> python int (limbs need not be canonical)."""
+    arr = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(arr[i]) << S[i] for i in range(arr.shape[0])) % P
+
+
+def zeros_like_batch(batch_shape) -> jnp.ndarray:
+    return jnp.zeros((NLIMBS, *batch_shape), dtype=jnp.uint32)
+
+
+def const_fe(x: int, batch_shape=()) -> jnp.ndarray:
+    """Broadcast a constant field element across a batch shape."""
+    limbs = jnp.asarray(from_int(x))
+    return jnp.broadcast_to(
+        limbs.reshape((NLIMBS,) + (1,) * len(batch_shape)), (NLIMBS, *batch_shape)
+    ).astype(jnp.uint32)
+
+
+def _carry_pass(limbs_list, widths):
+    """One sequential carry pass. limbs_list: python list of uint32 arrays.
+    Returns (list of in-range limbs, final carry array)."""
+    out = []
+    carry = jnp.zeros_like(limbs_list[0])
+    for k, x in enumerate(limbs_list):
+        x = x + carry
+        carry = x >> widths[k]
+        out.append(x & jnp.uint32((1 << widths[k]) - 1))
+    return out, carry
+
+
+@jax.jit
+def carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Two carry passes + wrap; output limbs < 2^{w_i} except limb0 < 2^13+38."""
+    limbs = [x[i] for i in range(NLIMBS)]
+    limbs, c = _carry_pass(limbs, W)
+    limbs[0] = limbs[0] + jnp.uint32(19) * c  # 2^255 ≡ 19
+    limbs, c = _carry_pass(limbs, W)
+    limbs[0] = limbs[0] + jnp.uint32(19) * c  # c ∈ {0,1,2} here; limb0 stays < 2^13+38
+    return jnp.stack(limbs)
+
+
+@jax.jit
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry(a + b)
+
+
+# Limbs of 2p (non-canonical: limbs exceed their widths) with per-limb headroom
+# >= 2^{w_i}+38 so (a + SUB2P - b) is non-negative limb-wise for any carried
+# a, b (loose limb0 <= 2^13+37 included). Greedy top-down decomposition, then
+# each limb borrows 2^{w_i} from the limb above (net zero).
+_SUB2P = np.zeros(NLIMBS, dtype=np.uint32)
+_rem = 2 * P
+for _i in reversed(range(NLIMBS)):
+    _SUB2P[_i] = _rem >> S[_i]
+    _rem -= int(_SUB2P[_i]) << S[_i]
+assert _rem == 0
+for _i in range(NLIMBS - 1, 0, -1):
+    _SUB2P[_i] -= 1
+    _SUB2P[_i - 1] += 1 << W[_i - 1]
+assert sum(int(_SUB2P[i]) << S[i] for i in range(NLIMBS)) == 2 * P
+assert all(int(_SUB2P[i]) >= (1 << W[i]) + 38 for i in range(NLIMBS))
+SUB2P = jnp.asarray(_SUB2P)
+
+
+@jax.jit
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b (mod p). Inputs must be carried (limb_i < 2^{w_i}+38)."""
+    shim = SUB2P.reshape((NLIMBS,) + (1,) * (a.ndim - 1))
+    return carry(a + shim - b)
+
+
+@jax.jit
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return sub(jnp.zeros_like(a), a)
+
+
+@jax.jit
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply. Inputs carried; output carried."""
+    # prod[k][...] = sum_{i+j=k} M[i,j] * a_i * b_j   (fits uint32, see header)
+    t = a[:, None] * b[None, :, ...]  # (20, 20, ...batch)
+    mm = M.reshape((NLIMBS, NLIMBS) + (1,) * (a.ndim - 1))
+    t = t * mm
+    batch_shape = a.shape[1:]
+    prod = [jnp.zeros(batch_shape, dtype=jnp.uint32) for _ in range(2 * NLIMBS - 1)]
+    for i in range(NLIMBS):
+        for j in range(NLIMBS):
+            prod[i + j] = prod[i + j] + t[i, j]
+    # Carry the 39-limb product, then fold high limbs down with factor 19.
+    prod, c = _carry_pass(prod, W[: 2 * NLIMBS - 1])
+    # carry c sits at position 39: s_39 = s_19 + 255 => folds to limb 19 x19
+    prod[NLIMBS - 1] = prod[NLIMBS - 1] + jnp.uint32(19) * c
+    lo = prod[:NLIMBS]
+    for k in range(NLIMBS, 2 * NLIMBS - 1):
+        lo[k - NLIMBS] = lo[k - NLIMBS] + jnp.uint32(19) * prod[k]
+    return carry(jnp.stack(lo))
+
+
+@jax.jit
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small constant k < 2^18."""
+    assert 0 < k < (1 << 18)
+    return carry(a * jnp.uint32(k))
+
+
+@jax.jit
+def freeze(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical representative in [0, p). Input carried."""
+    limbs = [a[i] for i in range(NLIMBS)]
+    limbs, c = _carry_pass(limbs, W)
+    limbs[0] = limbs[0] + jnp.uint32(19) * c
+    limbs, c = _carry_pass(limbs, W)
+    limbs[0] = limbs[0] + jnp.uint32(19) * c  # now value < 2^255 + 38
+    limbs, c = _carry_pass(limbs, W)
+    limbs[0] = limbs[0] + jnp.uint32(19) * c  # c<=1 and then limb0 < 57: no ripple
+    # Conditional subtract p: y = x + 19; if y carries out of bit 255, x >= p
+    # and the folded y (with the carry dropped) equals x - p.
+    ylimbs = list(limbs)
+    ylimbs[0] = ylimbs[0] + jnp.uint32(19)
+    ylimbs, yc = _carry_pass(ylimbs, W)
+    x = jnp.stack(limbs)
+    y = jnp.stack(ylimbs)
+    return jnp.where(yc[None] > 0, y, x)
+
+
+@jax.jit
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise field equality -> bool[...batch]."""
+    return jnp.all(freeze(a) == freeze(b), axis=0)
+
+
+@jax.jit
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(freeze(a) == 0, axis=0)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """cond ? a : b with cond shaped like the batch."""
+    return jnp.where(cond[None], a, b)
+
+
+def bit(a: jnp.ndarray, i: int) -> jnp.ndarray:
+    """Extract bit i of the canonical value. Input must be frozen."""
+    k = 0
+    while S[k + 1] <= i:
+        k += 1
+    return (a[k] >> jnp.uint32(i - S[k])) & jnp.uint32(1)
+
+
+def from_bytes(b: jnp.ndarray, mask_high_bit: bool = True) -> jnp.ndarray:
+    """Little-endian bytes uint8[32, ...batch] -> limbs (not reduced mod p).
+
+    mask_high_bit drops bit 255 (the ed25519 sign bit)."""
+    b = jnp.asarray(b).astype(jnp.uint32)
+    if mask_high_bit:
+        b = b.at[31].set(b[31] & jnp.uint32(0x7F))
+    bits = jnp.stack(
+        [(b[i // 8] >> jnp.uint32(i % 8)) & jnp.uint32(1) for i in range(256)]
+    )  # (256, ...batch)
+    limbs = []
+    for i in range(NLIMBS):
+        acc = jnp.zeros_like(bits[0])
+        for j in range(W[i]):
+            acc = acc + (bits[S[i] + j] << jnp.uint32(j))
+        limbs.append(acc)
+    # bit 255 (if unmasked) would be position 255 ≡ *19 — only reachable when
+    # mask_high_bit=False; fold it.
+    if not mask_high_bit:
+        limbs[0] = limbs[0] + jnp.uint32(19) * bits[255]
+    return carry(jnp.stack(limbs))
+
+
+@jax.jit
+def to_bytes(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical little-endian encoding uint8[32, ...batch]."""
+    f = freeze(a)
+    bits = []
+    for i in range(NLIMBS):
+        for j in range(W[i]):
+            bits.append((f[i] >> jnp.uint32(j)) & jnp.uint32(1))
+    bits.append(jnp.zeros_like(bits[0]))  # bit 255 = 0 in canonical form
+    out = []
+    for byte_i in range(32):
+        acc = jnp.zeros_like(bits[0])
+        for j in range(8):
+            acc = acc + (bits[8 * byte_i + j] << jnp.uint32(j))
+        out.append(acc)
+    return jnp.stack(out).astype(jnp.uint8)
+
+
+@jax.jit
+def is_canonical_bytes(b: jnp.ndarray) -> jnp.ndarray:
+    """True iff the 255-bit value encoded (sign bit ignored) is < p."""
+    v = from_bytes(b, mask_high_bit=True)
+    limbs = [v[i] for i in range(NLIMBS)]
+    limbs[0] = limbs[0] + jnp.uint32(19)
+    _, c = _carry_pass(limbs, W)
+    return c == 0
+
+
+def _pow2k(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a^(2^k) via k squarings (fori_loop keeps the traced graph small)."""
+    if k <= 2:
+        for _ in range(k):
+            a = square(a)
+        return a
+    return jax.lax.fori_loop(0, k, lambda _, x: square(x), a)
+
+
+def _z250(a: jnp.ndarray):
+    """Shared ladder: returns (x^(2^250 - 1), x^11, x^9). Classic 25519 chain."""
+    z2 = square(a)
+    z8 = _pow2k(z2, 2)
+    z9 = mul(a, z8)
+    z11 = mul(z2, z9)
+    z22 = square(z11)
+    z_5_0 = mul(z9, z22)  # x^(2^5 - 1)
+    z_10_5 = _pow2k(z_5_0, 5)
+    z_10_0 = mul(z_10_5, z_5_0)
+    z_20_10 = _pow2k(z_10_0, 10)
+    z_20_0 = mul(z_20_10, z_10_0)
+    z_40_20 = _pow2k(z_20_0, 20)
+    z_40_0 = mul(z_40_20, z_20_0)
+    z_50_40 = _pow2k(z_40_0, 10)
+    z_50_0 = mul(z_50_40, z_10_0)
+    z_100_50 = _pow2k(z_50_0, 50)
+    z_100_0 = mul(z_100_50, z_50_0)
+    z_200_100 = _pow2k(z_100_0, 100)
+    z_200_0 = mul(z_200_100, z_100_0)
+    z_250_200 = _pow2k(z_200_0, 50)
+    z_250_0 = mul(z_250_200, z_50_0)
+    return z_250_0, z11, z9
+
+
+@jax.jit
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """x^(p-2) = x^(2^255 - 21). inv(0) = 0."""
+    z_250_0, z11, _ = _z250(a)
+    z_255_5 = _pow2k(z_250_0, 5)
+    return mul(z_255_5, z11)
+
+
+@jax.jit
+def pow_p58(a: jnp.ndarray) -> jnp.ndarray:
+    """x^((p-5)/8) = x^(2^252 - 3)."""
+    z_250_0, _, _ = _z250(a)
+    z_252_2 = _pow2k(z_250_0, 2)
+    return mul(z_252_2, a)

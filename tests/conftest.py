@@ -8,7 +8,9 @@ not import this file.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even if the ambient environment points at a TPU (e.g.
+# JAX_PLATFORMS=axon); override with TMTPU_TEST_PLATFORM to test on hardware.
+os.environ["JAX_PLATFORMS"] = os.environ.get("TMTPU_TEST_PLATFORM", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

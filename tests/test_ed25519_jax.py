@@ -1,0 +1,176 @@
+"""Differential tests: JAX batched ed25519 vs pure-python RFC 8032 reference."""
+
+import numpy as np
+
+from tendermint_tpu.crypto import batch as cbatch
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.ops import ed25519_jax as ed
+from tendermint_tpu.ops import fe25519 as fe
+
+rng = np.random.default_rng(42)
+
+
+def fe_batch(ints):
+    return np.stack([fe.from_int(x) for x in ints], axis=-1)
+
+
+def point_batch(points):
+    """List of reference extended points -> JAX Point batch."""
+    return ed.Point(
+        fe_batch([p[0] for p in points]),
+        fe_batch([p[1] for p in points]),
+        fe_batch([p[2] for p in points]),
+        fe_batch([p[3] for p in points]),
+    )
+
+
+def point_to_ints(p, i):
+    return tuple(
+        fe.to_int(np.asarray(c)[:, i]) for c in (p.x, p.y, p.z, p.t)
+    )
+
+
+def rand_points(n):
+    pts = []
+    for _ in range(n):
+        k = int.from_bytes(rng.bytes(32), "little") % ref.L
+        pts.append(ref.point_mul(k, ref.BASE))
+    return pts
+
+
+def assert_points_equal(jp, ref_points):
+    for i, rp in enumerate(ref_points):
+        got = point_to_ints(jp, i)
+        assert ref.point_equal(got, rp), f"point {i} mismatch"
+        # T must remain consistent: T = XY/Z
+        x, y, z, t = got
+        assert (x * y - t * z) % ref.P == 0
+
+
+def test_point_add_matches_reference():
+    n = 8
+    ps, qs = rand_points(n), rand_points(n)
+    out = ed.point_add(point_batch(ps), point_batch(qs))
+    assert_points_equal(out, [ref.point_add(p, q) for p, q in zip(ps, qs)])
+
+
+def test_point_double_matches_reference_and_unified_add():
+    n = 8
+    ps = rand_points(n)
+    jp = point_batch(ps)
+    doubled = ed.point_double(jp)
+    assert_points_equal(doubled, [ref.point_double(p) for p in ps])
+    via_add = ed.point_add(jp, jp)
+    for i in range(n):
+        assert ref.point_equal(point_to_ints(doubled, i), point_to_ints(via_add, i))
+
+
+def test_add_identity_and_double_identity():
+    n = 4
+    ps = rand_points(n)
+    ident = ed.identity((n,))
+    out = ed.point_add(point_batch(ps), ident)
+    assert_points_equal(out, ps)
+    out2 = ed.point_double(ident)
+    assert_points_equal(out2, [ref.IDENTITY] * n)
+
+
+def test_compress_decompress_roundtrip():
+    n = 8
+    ps = rand_points(n)
+    enc_ref = [ref.point_compress(p) for p in ps]
+    enc = np.asarray(ed.compress(point_batch(ps)))
+    for i in range(n):
+        assert enc[:, i].tobytes() == enc_ref[i]
+    dec, ok = ed.decompress(np.stack([np.frombuffer(e, dtype=np.uint8) for e in enc_ref], axis=-1))
+    assert np.asarray(ok).all()
+    assert_points_equal(dec, ps)
+
+
+def test_decompress_rejects_invalid():
+    good = ref.point_compress(ref.BASE)
+    bad_not_on_curve = None
+    # find a y that has no valid x
+    for cand in range(2, 200):
+        if ref.point_decompress(int.to_bytes(cand, 32, "little")) is None:
+            bad_not_on_curve = int.to_bytes(cand, 32, "little")
+            break
+    assert bad_not_on_curve is not None
+    noncanonical = int.to_bytes(ref.P + 1, 32, "little")  # y >= p
+    arr = np.stack(
+        [np.frombuffer(x, dtype=np.uint8) for x in (good, bad_not_on_curve, noncanonical)],
+        axis=-1,
+    )
+    _, ok = ed.decompress(arr)
+    assert list(np.asarray(ok)) == [True, False, False]
+
+
+def _make_sigs(n, tamper=()):
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        seed = bytes([i + 1]) * 32
+        msg = b"block-vote-%d" % i
+        pub = ref.public_key(seed)
+        sig = ref.sign(seed, msg)
+        pubs.append(pub)
+        msgs.append(msg)
+        sigs.append(sig)
+    for i in tamper:
+        b = bytearray(sigs[i])
+        b[2] ^= 0xFF
+        sigs[i] = bytes(b)
+    return pubs, msgs, sigs
+
+
+def test_verify_batch_jax_all_valid():
+    pubs, msgs, sigs = _make_sigs(5)
+    mask = cbatch.verify_batch(pubs, msgs, sigs, backend="jax")
+    assert mask.tolist() == [True] * 5
+
+
+def test_verify_batch_jax_detects_bad():
+    pubs, msgs, sigs = _make_sigs(6, tamper=(1, 4))
+    mask = cbatch.verify_batch(pubs, msgs, sigs, backend="jax")
+    assert mask.tolist() == [True, False, True, True, False, True]
+    # cpu backend agrees exactly
+    cpu = cbatch.verify_batch(pubs, msgs, sigs, backend="cpu")
+    assert cpu.tolist() == mask.tolist()
+
+
+def test_verify_batch_jax_rejects_high_s():
+    pubs, msgs, sigs = _make_sigs(2)
+    s = int.from_bytes(sigs[0][32:], "little")
+    sigs[0] = sigs[0][:32] + int.to_bytes(s + ref.L, 32, "little")
+    mask = cbatch.verify_batch(pubs, msgs, sigs, backend="jax")
+    assert mask.tolist() == [False, True]
+
+
+def test_verify_batch_wrong_message_and_key():
+    pubs, msgs, sigs = _make_sigs(3)
+    msgs[0] = b"different"
+    pubs[1], pubs[2] = pubs[2], pubs[1]  # swapped keys
+    mask = cbatch.verify_batch(pubs, msgs, sigs, backend="jax")
+    assert mask.tolist() == [False, False, False]
+
+
+def test_verify_batch_malformed_inputs():
+    pubs, msgs, sigs = _make_sigs(3)
+    pubs[0] = pubs[0][:31]  # short key
+    sigs[1] = sigs[1][:63]  # short sig
+    mask = cbatch.verify_batch(pubs, msgs, sigs, backend="jax")
+    assert mask.tolist() == [False, False, True]
+
+
+def test_batch_verifier_interface():
+    pubs, msgs, sigs = _make_sigs(4, tamper=(2,))
+    bv = cbatch.Ed25519BatchVerifier(backend="jax")
+    for p, m, s in zip(pubs, msgs, sigs):
+        bv.add(p, m, s)
+    assert len(bv) == 4
+    assert bv.verify().tolist() == [True, True, False, True]
+    bv.reset()
+    assert len(bv) == 0
+
+
+def test_empty_batch():
+    assert cbatch.verify_batch([], [], []).tolist() == []

@@ -1,0 +1,147 @@
+"""Differential tests: JAX GF(2^255-19) kernel vs Python big-int arithmetic."""
+
+import numpy as np
+
+from tendermint_tpu.ops import fe25519 as fe
+
+P = fe.P
+rng = np.random.default_rng(1234)
+
+
+def rand_ints(n, below=P):
+    return [int.from_bytes(rng.bytes(40), "little") % below for _ in range(n)]
+
+
+def batch_from_ints(xs):
+    return np.stack([fe.from_int(x) for x in xs], axis=-1)  # (20, n)
+
+
+def batch_to_ints(limbs):
+    arr = np.asarray(limbs)
+    return [fe.to_int(arr[:, i]) for i in range(arr.shape[1])]
+
+
+def test_roundtrip_int():
+    for x in rand_ints(20) + [0, 1, P - 1, P - 19, 2**255 - 20]:
+        assert fe.to_int(fe.from_int(x)) == x % P
+
+
+def test_add_sub_mul_random():
+    n = 64
+    a, b = rand_ints(n), rand_ints(n)
+    A, B = batch_from_ints(a), batch_from_ints(b)
+    assert batch_to_ints(fe.add(A, B)) == [(x + y) % P for x, y in zip(a, b)]
+    assert batch_to_ints(fe.sub(A, B)) == [(x - y) % P for x, y in zip(a, b)]
+    assert batch_to_ints(fe.mul(A, B)) == [(x * y) % P for x, y in zip(a, b)]
+    assert batch_to_ints(fe.square(A)) == [(x * x) % P for x in a]
+
+
+def test_mul_worst_case_limbs():
+    # All limbs at their loose maximum: 2^{w_i}-1 (+38 on limb 0) — the bound
+    # the uint32 accumulation analysis relies on.
+    big = np.array([(1 << w) - 1 for w in fe.W[: fe.NLIMBS]], dtype=np.uint32)
+    big0 = big.copy()
+    big0[0] += 38
+    A = np.stack([big0, big], axis=-1)
+    va = [fe.to_int(A[:, i]) for i in range(2)]
+    got = batch_to_ints(fe.mul(A, A))
+    assert got == [(x * x) % P for x in va]
+
+
+def test_edge_values():
+    xs = [0, 1, 2, 19, P - 1, P - 2, (P + 1) // 2, 2**255 - 20]
+    ys = [P - 1, 1, P - 19, 0, P - 1, 2, 3, 2**254]
+    A, B = batch_from_ints(xs), batch_from_ints(ys)
+    assert batch_to_ints(fe.mul(A, B)) == [(x * y) % P for x, y in zip(xs, ys)]
+    assert batch_to_ints(fe.sub(A, B)) == [(x - y) % P for x, y in zip(xs, ys)]
+
+
+def test_chained_ops_stay_reduced():
+    # Long chains of ops must not overflow or drift.
+    n = 8
+    a = rand_ints(n)
+    A = batch_from_ints(a)
+    ref = list(a)
+    X = A
+    for i in range(50):
+        X = fe.mul(X, A) if i % 3 else fe.add(fe.sub(X, A), X)
+        ref = [
+            (r * x) % P if i % 3 else ((r - x) + r) % P for r, x in zip(ref, a)
+        ]
+    assert batch_to_ints(X) == ref
+
+
+def test_freeze_and_eq():
+    n = 16
+    a = rand_ints(n)
+    A = batch_from_ints(a)
+    # a + (p) and a must compare equal
+    App = fe.add(A, batch_from_ints([P - 19])[:, [0] * n])
+    assert list(np.asarray(fe.eq(A, fe.add(A, fe.const_fe(0, (n,)))))) == [True] * n
+    frozen = np.asarray(fe.freeze(App))
+    assert batch_to_ints(frozen) == [(x + P - 19) % P for x in a]
+
+
+def test_inv():
+    n = 16
+    a = rand_ints(n)
+    A = batch_from_ints(a)
+    got = batch_to_ints(fe.inv(A))
+    assert got == [pow(x, P - 2, P) for x in a]
+    # inv(0) == 0
+    Z = batch_from_ints([0])
+    assert batch_to_ints(fe.inv(Z)) == [0]
+
+
+def test_pow_p58():
+    n = 8
+    a = rand_ints(n)
+    A = batch_from_ints(a)
+    got = batch_to_ints(fe.pow_p58(A))
+    assert got == [pow(x, (P - 5) // 8, P) for x in a]
+
+
+def test_bytes_roundtrip():
+    n = 32
+    xs = rand_ints(n) + [0, 1, P - 1]
+    A = batch_from_ints(xs)
+    enc = np.asarray(fe.to_bytes(A))  # (32, n)
+    for i, x in enumerate(xs):
+        assert enc[:, i].tobytes() == int.to_bytes(x, 32, "little")
+    back = fe.from_bytes(np.asarray(enc))
+    assert batch_to_ints(back) == [x % P for x in xs]
+
+
+def test_from_bytes_masks_sign_bit():
+    x = P - 5
+    raw = bytearray(int.to_bytes(x, 32, "little"))
+    raw[31] |= 0x80
+    arr = np.frombuffer(bytes(raw), dtype=np.uint8).reshape(32, 1)
+    assert batch_to_ints(fe.from_bytes(arr))[0] == x
+
+
+def test_is_canonical_bytes():
+    cases = {0: True, 1: True, P - 1: True, P: False, P + 5: False, 2**255 - 1: False}
+    vals = list(cases)
+    arr = np.stack(
+        [np.frombuffer(int.to_bytes(v, 32, "little"), dtype=np.uint8) for v in vals],
+        axis=-1,
+    )
+    got = list(np.asarray(fe.is_canonical_bytes(arr)))
+    assert got == [cases[v] for v in vals]
+
+
+def test_mul_small_and_neg():
+    n = 8
+    a = rand_ints(n)
+    A = batch_from_ints(a)
+    assert batch_to_ints(fe.mul_small(A, 121666)) == [x * 121666 % P for x in a]
+    assert batch_to_ints(fe.neg(A)) == [(-x) % P for x in a]
+
+
+def test_bit():
+    xs = [1, 2, P - 1, 7]
+    A = fe.freeze(batch_from_ints(xs))
+    assert list(np.asarray(fe.bit(A, 0))) == [x & 1 for x in xs]
+    assert list(np.asarray(fe.bit(A, 1))) == [(x >> 1) & 1 for x in xs]
+    assert list(np.asarray(fe.bit(A, 254))) == [(x >> 254) & 1 for x in xs]
