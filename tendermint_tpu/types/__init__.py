@@ -1,0 +1,29 @@
+from tendermint_tpu.types.basic import (  # noqa: F401
+    BlockID,
+    BlockIDFlag,
+    PartSetHeader,
+    SignedMsgType,
+    ZERO_BLOCK_ID,
+)
+from tendermint_tpu.types.block import (  # noqa: F401
+    Block,
+    Commit,
+    CommitSig,
+    ConsensusVersion,
+    EMPTY_COMMIT,
+    Header,
+    txs_hash,
+)
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, decode_evidence  # noqa: F401
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator  # noqa: F401
+from tendermint_tpu.types.params import ConsensusParams, DEFAULT_CONSENSUS_PARAMS  # noqa: F401
+from tendermint_tpu.types.part_set import Part, PartSet  # noqa: F401
+from tendermint_tpu.types.proposal import Proposal  # noqa: F401
+from tendermint_tpu.types.validator_set import (  # noqa: F401
+    CommitVerifyError,
+    NotEnoughVotingPowerError,
+    Validator,
+    ValidatorSet,
+)
+from tendermint_tpu.types.vote import Vote  # noqa: F401
+from tendermint_tpu.types.vote_set import ConflictingVotesError, VoteSet, VoteSetError  # noqa: F401

@@ -1,0 +1,439 @@
+"""Block, Header, Commit, CommitSig, Data (reference: types/block.go).
+
+Hashing follows the reference scheme: Header.Hash is the merkle root of the 14
+proto-encoded header fields (reference: types/block.go Header.Hash +
+types/encoding_helper.go cdcEncode — primitives are wrapped in single-field
+proto messages); Data.Hash is the merkle root over SHA-256 tx hashes
+(reference: types/tx.go Txs.Hash); Commit.Hash is the merkle root over
+proto-encoded CommitSigs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field, replace
+from typing import List, Optional, Sequence
+
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.crypto.merkle import hash_from_byte_slices
+from tendermint_tpu.libs import protowire as pw
+from tendermint_tpu.types.basic import (
+    BlockID,
+    BlockIDFlag,
+    PartSetHeader,
+    SignedMsgType,
+    ts_seconds_nanos,
+)
+from tendermint_tpu.types import canonical
+from tendermint_tpu.types.vote import Vote
+
+MAX_HEADER_BYTES = 626
+
+
+def _cdc_bytes(b: bytes) -> bytes:
+    w = pw.Writer()
+    w.bytes_field(1, b)
+    return w.bytes()
+
+
+def _cdc_string(s: str) -> bytes:
+    w = pw.Writer()
+    w.string_field(1, s)
+    return w.bytes()
+
+
+def _cdc_int64(v: int) -> bytes:
+    w = pw.Writer()
+    w.varint_field(1, v)
+    return w.bytes()
+
+
+def tx_hash(tx: bytes) -> bytes:
+    return tmhash.sum256(tx)
+
+
+def txs_hash(txs: Sequence[bytes]) -> bytes:
+    return hash_from_byte_slices([tx_hash(tx) for tx in txs])
+
+
+@dataclass(frozen=True)
+class ConsensusVersion:
+    """reference: proto/tendermint/version/types.proto Consensus."""
+
+    block: int = 11  # BlockProtocol, reference: version/version.go
+    app: int = 0
+
+    def encode(self) -> bytes:
+        w = pw.Writer()
+        w.varint_field(1, self.block)
+        w.varint_field(2, self.app)
+        return w.bytes()
+
+
+@dataclass(frozen=True)
+class Header:
+    version: ConsensusVersion
+    chain_id: str
+    height: int
+    time_ns: int
+    last_block_id: BlockID
+    last_commit_hash: bytes
+    data_hash: bytes
+    validators_hash: bytes
+    next_validators_hash: bytes
+    consensus_hash: bytes
+    app_hash: bytes
+    last_results_hash: bytes
+    evidence_hash: bytes
+    proposer_address: bytes
+
+    def hash(self) -> bytes:
+        """Merkle root over the proto-encoded fields (reference:
+        types/block.go Header.Hash). Returns b"" if the header is incomplete."""
+        if not self.validators_hash:
+            return b""
+        sec, nanos = ts_seconds_nanos(self.time_ns)
+        fields = [
+            self.version.encode(),
+            _cdc_string(self.chain_id),
+            _cdc_int64(self.height),
+            pw.encode_timestamp(sec, nanos),
+            self.last_block_id.encode(),
+            _cdc_bytes(self.last_commit_hash),
+            _cdc_bytes(self.data_hash),
+            _cdc_bytes(self.validators_hash),
+            _cdc_bytes(self.next_validators_hash),
+            _cdc_bytes(self.consensus_hash),
+            _cdc_bytes(self.app_hash),
+            _cdc_bytes(self.last_results_hash),
+            _cdc_bytes(self.evidence_hash),
+            _cdc_bytes(self.proposer_address),
+        ]
+        return hash_from_byte_slices(fields)
+
+    def validate_basic(self) -> None:
+        if len(self.chain_id) > 50:
+            raise ValueError("chainID is too long")
+        if self.height < 0:
+            raise ValueError("negative Header.Height")
+        if self.height == 0:
+            raise ValueError("zero Header.Height")
+        self.last_block_id.validate_basic()
+        for name in (
+            "last_commit_hash",
+            "data_hash",
+            "evidence_hash",
+            "last_results_hash",
+            "validators_hash",
+            "next_validators_hash",
+            "consensus_hash",
+        ):
+            h = getattr(self, name)
+            if h and len(h) != tmhash.SIZE:
+                raise ValueError(f"wrong {name} size")
+        if len(self.proposer_address) != tmhash.TRUNCATED_SIZE:
+            raise ValueError("invalid ProposerAddress length")
+
+    def encode(self) -> bytes:
+        sec, nanos = ts_seconds_nanos(self.time_ns)
+        w = pw.Writer()
+        w.message_field(1, self.version.encode(), always=True)
+        w.string_field(2, self.chain_id)
+        w.varint_field(3, self.height)
+        w.message_field(4, pw.encode_timestamp(sec, nanos), always=True)
+        w.message_field(5, self.last_block_id.encode(), always=True)
+        w.bytes_field(6, self.last_commit_hash)
+        w.bytes_field(7, self.data_hash)
+        w.bytes_field(8, self.validators_hash)
+        w.bytes_field(9, self.next_validators_hash)
+        w.bytes_field(10, self.consensus_hash)
+        w.bytes_field(11, self.app_hash)
+        w.bytes_field(12, self.last_results_hash)
+        w.bytes_field(13, self.evidence_hash)
+        w.bytes_field(14, self.proposer_address)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Header":
+        kw = dict(
+            version=ConsensusVersion(),
+            chain_id="",
+            height=0,
+            time_ns=0,
+            last_block_id=BlockID(),
+            last_commit_hash=b"",
+            data_hash=b"",
+            validators_hash=b"",
+            next_validators_hash=b"",
+            consensus_hash=b"",
+            app_hash=b"",
+            last_results_hash=b"",
+            evidence_hash=b"",
+            proposer_address=b"",
+        )
+        for f, _, v in pw.Reader(data):
+            if f == 1:
+                blk = app = 0
+                for ff, _, vv in pw.Reader(v):
+                    if ff == 1:
+                        blk = vv
+                    elif ff == 2:
+                        app = vv
+                kw["version"] = ConsensusVersion(blk, app)
+            elif f == 2:
+                kw["chain_id"] = v.decode("utf-8")
+            elif f == 3:
+                kw["height"] = pw.int64_from_varint(v)
+            elif f == 4:
+                sec = nanos = 0
+                for ff, _, vv in pw.Reader(v):
+                    if ff == 1:
+                        sec = pw.int64_from_varint(vv)
+                    elif ff == 2:
+                        nanos = pw.int64_from_varint(vv)
+                kw["time_ns"] = sec * 1_000_000_000 + nanos
+            elif f == 5:
+                kw["last_block_id"] = BlockID.decode(v)
+            elif f == 6:
+                kw["last_commit_hash"] = v
+            elif f == 7:
+                kw["data_hash"] = v
+            elif f == 8:
+                kw["validators_hash"] = v
+            elif f == 9:
+                kw["next_validators_hash"] = v
+            elif f == 10:
+                kw["consensus_hash"] = v
+            elif f == 11:
+                kw["app_hash"] = v
+            elif f == 12:
+                kw["last_results_hash"] = v
+            elif f == 13:
+                kw["evidence_hash"] = v
+            elif f == 14:
+                kw["proposer_address"] = v
+        return cls(**kw)
+
+
+@dataclass(frozen=True)
+class CommitSig:
+    block_id_flag: BlockIDFlag
+    validator_address: bytes = b""
+    timestamp_ns: int = 0
+    signature: bytes = b""
+
+    @classmethod
+    def absent_sig(cls) -> "CommitSig":
+        return cls(block_id_flag=BlockIDFlag.ABSENT)
+
+    def absent(self) -> bool:
+        return self.block_id_flag == BlockIDFlag.ABSENT
+
+    def for_block(self) -> bool:
+        return self.block_id_flag == BlockIDFlag.COMMIT
+
+    def block_id(self, commit_block_id: BlockID) -> BlockID:
+        """(reference: types/block.go:638-651)"""
+        if self.block_id_flag == BlockIDFlag.COMMIT:
+            return commit_block_id
+        return BlockID()
+
+    def validate_basic(self) -> None:
+        if self.block_id_flag not in (BlockIDFlag.ABSENT, BlockIDFlag.COMMIT, BlockIDFlag.NIL):
+            raise ValueError(f"unknown BlockIDFlag: {self.block_id_flag}")
+        if self.absent():
+            if self.validator_address:
+                raise ValueError("validator address is present for absent CommitSig")
+            if self.signature:
+                raise ValueError("signature is present for absent CommitSig")
+        else:
+            if len(self.validator_address) != tmhash.TRUNCATED_SIZE:
+                raise ValueError("expected ValidatorAddress size to be 20 bytes")
+            if not self.signature:
+                raise ValueError("signature is missing")
+            if len(self.signature) > 64:
+                raise ValueError("signature is too big")
+
+    def encode(self) -> bytes:
+        w = pw.Writer()
+        w.varint_field(1, int(self.block_id_flag))
+        w.bytes_field(2, self.validator_address)
+        sec, nanos = ts_seconds_nanos(self.timestamp_ns)
+        w.message_field(3, pw.encode_timestamp(sec, nanos), always=True)
+        w.bytes_field(4, self.signature)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "CommitSig":
+        flag = BlockIDFlag.ABSENT
+        addr = b""
+        ts = 0
+        sig = b""
+        for f, _, v in pw.Reader(data):
+            if f == 1:
+                flag = BlockIDFlag(v)
+            elif f == 2:
+                addr = v
+            elif f == 3:
+                sec = nanos = 0
+                for ff, _, vv in pw.Reader(v):
+                    if ff == 1:
+                        sec = pw.int64_from_varint(vv)
+                    elif ff == 2:
+                        nanos = pw.int64_from_varint(vv)
+                ts = sec * 1_000_000_000 + nanos
+            elif f == 4:
+                sig = v
+        return cls(flag, addr, ts, sig)
+
+
+@dataclass(frozen=True)
+class Commit:
+    height: int
+    round: int
+    block_id: BlockID
+    signatures: tuple
+
+    def __post_init__(self):
+        object.__setattr__(self, "signatures", tuple(self.signatures))
+
+    def size(self) -> int:
+        return len(self.signatures)
+
+    def get_vote(self, val_idx: int) -> Vote:
+        """(reference: types/block.go:770-782)"""
+        cs = self.signatures[val_idx]
+        return Vote(
+            type=SignedMsgType.PRECOMMIT,
+            height=self.height,
+            round=self.round,
+            block_id=cs.block_id(self.block_id),
+            timestamp_ns=cs.timestamp_ns,
+            validator_address=cs.validator_address,
+            validator_index=val_idx,
+            signature=cs.signature,
+        )
+
+    def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
+        cs = self.signatures[val_idx]
+        return canonical.vote_sign_bytes(
+            chain_id,
+            SignedMsgType.PRECOMMIT,
+            self.height,
+            self.round,
+            cs.block_id(self.block_id),
+            cs.timestamp_ns,
+        )
+
+    def hash(self) -> bytes:
+        return hash_from_byte_slices([cs.encode() for cs in self.signatures])
+
+    def validate_basic(self) -> None:
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.round < 0:
+            raise ValueError("negative Round")
+        if self.height >= 1:
+            if self.block_id.is_zero():
+                raise ValueError("commit cannot be for nil block")
+            if not self.signatures:
+                raise ValueError("no signatures in commit")
+            for cs in self.signatures:
+                cs.validate_basic()
+
+    def encode(self) -> bytes:
+        w = pw.Writer()
+        w.varint_field(1, self.height)
+        w.varint_field(2, self.round)
+        w.message_field(3, self.block_id.encode(), always=True)
+        for cs in self.signatures:
+            w.message_field(4, cs.encode(), always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Commit":
+        height = round_ = 0
+        block_id = BlockID()
+        sigs: List[CommitSig] = []
+        for f, _, v in pw.Reader(data):
+            if f == 1:
+                height = pw.int64_from_varint(v)
+            elif f == 2:
+                round_ = pw.int64_from_varint(v)
+            elif f == 3:
+                block_id = BlockID.decode(v)
+            elif f == 4:
+                sigs.append(CommitSig.decode(v))
+        return cls(height, round_, block_id, tuple(sigs))
+
+
+EMPTY_COMMIT = Commit(height=0, round=0, block_id=BlockID(), signatures=())
+
+
+@dataclass(frozen=True)
+class Block:
+    header: Header
+    txs: tuple
+    evidence: tuple
+    last_commit: Commit
+
+    def __post_init__(self):
+        object.__setattr__(self, "txs", tuple(self.txs))
+        object.__setattr__(self, "evidence", tuple(self.evidence))
+
+    def hash(self) -> bytes:
+        return self.header.hash()
+
+    def data_hash(self) -> bytes:
+        return txs_hash(self.txs)
+
+    def validate_basic(self) -> None:
+        self.header.validate_basic()
+        self.last_commit.validate_basic()
+        if self.header.height > 1 and self.last_commit.size() == 0:
+            raise ValueError("nil LastCommit")
+        if self.header.last_commit_hash != self.last_commit.hash():
+            raise ValueError("wrong Header.LastCommitHash")
+        if self.header.data_hash != self.data_hash():
+            raise ValueError("wrong Header.DataHash")
+        ev_hash = hash_from_byte_slices([e.hash() for e in self.evidence])
+        if self.header.evidence_hash != ev_hash:
+            raise ValueError("wrong Header.EvidenceHash")
+
+    def encode(self) -> bytes:
+        w = pw.Writer()
+        w.message_field(1, self.header.encode(), always=True)
+        data = pw.Writer()
+        for tx in self.txs:
+            data.bytes_field(1, tx, emit_empty=True)
+        w.message_field(2, data.bytes(), always=True)
+        ev = pw.Writer()
+        for e in self.evidence:
+            ev.message_field(1, e.encode(), always=True)
+        w.message_field(3, ev.bytes(), always=True)
+        w.message_field(4, self.last_commit.encode(), always=True)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Block":
+        from tendermint_tpu.types.evidence import decode_evidence
+
+        header = None
+        txs: List[bytes] = []
+        evidence = []
+        last_commit = EMPTY_COMMIT
+        for f, _, v in pw.Reader(data):
+            if f == 1:
+                header = Header.decode(v)
+            elif f == 2:
+                for ff, _, vv in pw.Reader(v):
+                    if ff == 1:
+                        txs.append(vv)
+            elif f == 3:
+                for ff, _, vv in pw.Reader(v):
+                    if ff == 1:
+                        evidence.append(decode_evidence(vv))
+            elif f == 4:
+                last_commit = Commit.decode(v)
+        if header is None:
+            raise ValueError("block missing header")
+        return cls(header, tuple(txs), tuple(evidence), last_commit)

@@ -1,0 +1,118 @@
+"""Vote type (reference: types/vote.go).
+
+A Vote is a signed prevote or precommit for a block (or nil). Sign-bytes are
+the canonical length-delimited proto (tendermint_tpu.types.canonical); the wire
+encoding mirrors proto/tendermint/types/types.proto Vote (fields 1-8).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from tendermint_tpu.crypto.keys import PubKey
+from tendermint_tpu.libs import protowire as pw
+from tendermint_tpu.types import canonical
+from tendermint_tpu.types.basic import BlockID, SignedMsgType, ts_seconds_nanos
+
+
+@dataclass(frozen=True)
+class Vote:
+    type: SignedMsgType
+    height: int
+    round: int
+    block_id: BlockID
+    timestamp_ns: int
+    validator_address: bytes
+    validator_index: int
+    signature: bytes = b""
+
+    def is_nil(self) -> bool:
+        return self.block_id.is_zero()
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical.vote_sign_bytes(
+            chain_id, self.type, self.height, self.round, self.block_id, self.timestamp_ns
+        )
+
+    def verify(self, chain_id: str, pubkey: PubKey) -> bool:
+        """Serial verification (reference: types/vote.go:149). The batched path
+        goes through crypto.batch instead."""
+        from tendermint_tpu.crypto.keys import address_from_pubkey_bytes
+
+        if address_from_pubkey_bytes(pubkey.bytes()) != self.validator_address:
+            return False
+        return pubkey.verify(self.sign_bytes(chain_id), self.signature)
+
+    def validate_basic(self) -> None:
+        if self.type not in (SignedMsgType.PREVOTE, SignedMsgType.PRECOMMIT):
+            raise ValueError("invalid vote type")
+        if self.height < 0:
+            raise ValueError("negative height")
+        if self.round < 0:
+            raise ValueError("negative round")
+        self.block_id.validate_basic()
+        if not self.block_id.is_zero() and not self.block_id.is_complete():
+            raise ValueError(f"blockID must be either empty or complete, got: {self.block_id}")
+        if len(self.validator_address) != 20:
+            raise ValueError("wrong validator address size")
+        if self.validator_index < 0:
+            raise ValueError("negative validator index")
+        if not self.signature:
+            raise ValueError("signature is missing")
+        if len(self.signature) > 64:
+            raise ValueError("signature too big")
+
+    def with_signature(self, sig: bytes) -> "Vote":
+        return replace(self, signature=sig)
+
+    # Wire encoding (proto Vote, fields per types.proto)
+    def encode(self) -> bytes:
+        w = pw.Writer()
+        w.varint_field(1, int(self.type))
+        w.varint_field(2, self.height)
+        w.varint_field(3, self.round)
+        bid = self.block_id.encode()
+        w.message_field(4, bid, always=True)
+        sec, nanos = ts_seconds_nanos(self.timestamp_ns)
+        w.message_field(5, pw.encode_timestamp(sec, nanos), always=True)
+        w.bytes_field(6, self.validator_address)
+        w.varint_field(7, self.validator_index)
+        w.bytes_field(8, self.signature)
+        return w.bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Vote":
+        vals = {
+            "type": SignedMsgType.UNKNOWN,
+            "height": 0,
+            "round": 0,
+            "block_id": BlockID(),
+            "timestamp_ns": 0,
+            "validator_address": b"",
+            "validator_index": 0,
+            "signature": b"",
+        }
+        for f, _, v in pw.Reader(data):
+            if f == 1:
+                vals["type"] = SignedMsgType(v)
+            elif f == 2:
+                vals["height"] = pw.int64_from_varint(v)
+            elif f == 3:
+                vals["round"] = pw.int64_from_varint(v)
+            elif f == 4:
+                vals["block_id"] = BlockID.decode(v)
+            elif f == 5:
+                sec = nanos = 0
+                for ff, _, vv in pw.Reader(v):
+                    if ff == 1:
+                        sec = pw.int64_from_varint(vv)
+                    elif ff == 2:
+                        nanos = pw.int64_from_varint(vv)
+                vals["timestamp_ns"] = sec * 1_000_000_000 + nanos
+            elif f == 6:
+                vals["validator_address"] = v
+            elif f == 7:
+                vals["validator_index"] = pw.int64_from_varint(v)
+            elif f == 8:
+                vals["signature"] = v
+        return cls(**vals)

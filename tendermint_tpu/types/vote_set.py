@@ -1,0 +1,315 @@
+"""VoteSet: tallies votes of one (height, round, type) (reference: types/vote_set.go).
+
+Tracks one canonical vote per validator, per-block power sums, 2/3 majority
+detection, conflict detection (→ DuplicateVoteEvidence material) and
+peer-claimed majorities (used by the consensus reactor's VoteSetBits gossip).
+The add path mirrors the reference's addVerifiedVote exactly
+(reference: types/vote_set.go:229-290): a conflicting vote is still tracked
+under its block key when a peer claims that block has 2/3, and the canonical
+vote is replaced when the conflict is FOR the established maj23 block.
+
+Signature verification: votes are verified on arrival through the host path by
+default; `defer_verification=True` accumulates unverified votes and `flush()`
+batch-verifies them on the TPU in one kernel call — the mode the consensus
+vote-storm path uses (north star: SURVEY.md §3.3). Conflicts discovered during
+flush are queued and retrievable via pop_conflicts().
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from tendermint_tpu.crypto.batch import verify_batch
+from tendermint_tpu.types.basic import BlockID, SignedMsgType
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote
+
+
+class VoteSetError(Exception):
+    pass
+
+
+class ConflictingVotesError(VoteSetError):
+    def __init__(self, vote_a: Vote, vote_b: Vote):
+        super().__init__("conflicting votes from validator")
+        self.vote_a = vote_a  # existing
+        self.vote_b = vote_b  # new
+
+
+@dataclass
+class _BlockVotes:
+    peer_maj23: bool
+    votes: List[Optional[Vote]]
+    sum: int = 0
+
+    def add_verified(self, idx: int, vote: Vote, power: int) -> None:
+        if self.votes[idx] is None:
+            self.votes[idx] = vote
+            self.sum += power
+
+    def get_by_index(self, idx: int) -> Optional[Vote]:
+        return self.votes[idx]
+
+
+class VoteSet:
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        round_: int,
+        signed_msg_type: SignedMsgType,
+        val_set: ValidatorSet,
+        defer_verification: bool = False,
+    ):
+        if height == 0:
+            raise ValueError("cannot make VoteSet for height == 0")
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round_
+        self.signed_msg_type = signed_msg_type
+        self.val_set = val_set
+        self.defer_verification = defer_verification
+
+        n = val_set.size()
+        self._votes: List[Optional[Vote]] = [None] * n
+        self._votes_bit_array: List[bool] = [False] * n
+        self._sum = 0
+        self._maj23: Optional[BlockID] = None
+        self._votes_by_block: Dict[bytes, _BlockVotes] = {}
+        self._peer_maj23s: Dict[str, BlockID] = {}
+        self._pending: List[Tuple[int, Vote]] = []  # deferred-verification queue
+        self._pending_seen: Set[Tuple[int, bytes, bytes]] = set()
+        self._conflicts: List[ConflictingVotesError] = []
+
+    # -- introspection ------------------------------------------------------
+
+    def size(self) -> int:
+        return self.val_set.size()
+
+    def bit_array(self) -> List[bool]:
+        return list(self._votes_bit_array)
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> Optional[List[bool]]:
+        bv = self._votes_by_block.get(block_id.key())
+        if bv is None:
+            return None
+        return [v is not None for v in bv.votes]
+
+    def get_by_index(self, idx: int) -> Optional[Vote]:
+        return self._votes[idx]
+
+    def get_by_address(self, address: bytes) -> Optional[Vote]:
+        idx, _ = self.val_set.get_by_address(address)
+        return self._votes[idx] if idx >= 0 else None
+
+    def list_votes(self) -> List[Vote]:
+        return [v for v in self._votes if v is not None]
+
+    def has_two_thirds_majority(self) -> bool:
+        return self._maj23 is not None
+
+    def two_thirds_majority(self) -> Optional[BlockID]:
+        return self._maj23
+
+    def has_two_thirds_any(self) -> bool:
+        return self._sum > self.val_set.total_voting_power() * 2 // 3
+
+    def has_all(self) -> bool:
+        return self._sum == self.val_set.total_voting_power()
+
+    def sum_power(self) -> int:
+        return self._sum
+
+    def pop_conflicts(self) -> List[ConflictingVotesError]:
+        out, self._conflicts = self._conflicts, []
+        return out
+
+    # -- adding votes -------------------------------------------------------
+
+    def _get_vote(self, idx: int, block_key: bytes) -> Optional[Vote]:
+        """Existing vote by idx for this block key, canonical or conflict-tracked
+        (reference: types/vote_set.go getVote)."""
+        existing = self._votes[idx]
+        if existing is not None and existing.block_id.key() == block_key:
+            return existing
+        bv = self._votes_by_block.get(block_key)
+        if bv is not None:
+            return bv.get_by_index(idx)
+        return None
+
+    def add_vote(self, vote: Vote) -> bool:
+        """Returns True if the vote was newly added. Raises VoteSetError on
+        invalid votes and ConflictingVotesError on equivocation
+        (reference: types/vote_set.go:143-290)."""
+        if vote is None:
+            raise VoteSetError("nil vote")
+        idx = vote.validator_index
+        if idx < 0:
+            raise VoteSetError("index < 0")
+        if not vote.signature:
+            raise VoteSetError("no signature")
+        if (
+            vote.height != self.height
+            or vote.round != self.round
+            or vote.type != self.signed_msg_type
+        ):
+            raise VoteSetError(
+                f"expected {self.height}/{self.round}/{self.signed_msg_type}, got "
+                f"{vote.height}/{vote.round}/{vote.type}"
+            )
+        addr, val = self.val_set.get_by_index(idx)
+        if val is None:
+            raise VoteSetError(f"cannot find validator {idx} in valSet of size {self.size()}")
+        if addr != vote.validator_address:
+            raise VoteSetError("validator address does not match index")
+
+        block_key = vote.block_id.key()
+        existing = self._get_vote(idx, block_key)
+        if existing is not None:
+            if existing.signature == vote.signature:
+                return False  # duplicate
+            raise VoteSetError("non-deterministic signature for the same block")
+
+        if self.defer_verification:
+            seen_key = (idx, block_key, vote.signature)
+            if seen_key in self._pending_seen:
+                return False
+            self._pending_seen.add(seen_key)
+            self._pending.append((idx, vote))
+            return True
+
+        if not self._verify_now(vote, val.pub_key):
+            raise VoteSetError(f"invalid signature from validator {idx}")
+        added, conflicting = self._add_verified(idx, vote, val.voting_power)
+        if conflicting is not None:
+            raise ConflictingVotesError(conflicting, vote)
+        return added
+
+    def _verify_now(self, vote: Vote, pub_key) -> bool:
+        return pub_key.verify(vote.sign_bytes(self.chain_id), vote.signature)
+
+    def flush(self) -> List[int]:
+        """Batch-verify all deferred votes in one device call; commits the
+        valid ones through the same conflict-aware path as add_vote. Returns
+        indices of votes that FAILED verification; conflicts discovered are
+        available via pop_conflicts()."""
+        if not self._pending:
+            return []
+        pubkeys, msgs, sigs = [], [], []
+        for idx, vote in self._pending:
+            _, val = self.val_set.get_by_index(idx)
+            pubkeys.append(val.pub_key.bytes())
+            msgs.append(vote.sign_bytes(self.chain_id))
+            sigs.append(vote.signature)
+        mask = verify_batch(pubkeys, msgs, sigs)
+        failed = []
+        for ok, (idx, vote) in zip(mask, self._pending):
+            if not ok:
+                failed.append(idx)
+                continue
+            _, val = self.val_set.get_by_index(idx)
+            # Re-check: an earlier pending vote may have committed already.
+            if self._get_vote(idx, vote.block_id.key()) is not None:
+                continue
+            _, conflicting = self._add_verified(idx, vote, val.voting_power)
+            if conflicting is not None:
+                self._conflicts.append(ConflictingVotesError(conflicting, vote))
+        self._pending.clear()
+        self._pending_seen.clear()
+        return failed
+
+    def _add_verified(
+        self, idx: int, vote: Vote, power: int
+    ) -> Tuple[bool, Optional[Vote]]:
+        """Mirror of reference addVerifiedVote (types/vote_set.go:229-290).
+        Assumes the signature is already verified."""
+        block_key = vote.block_id.key()
+        conflicting: Optional[Vote] = None
+
+        existing = self._votes[idx]
+        if existing is not None:
+            conflicting = existing
+            # Replace the canonical vote if the new one is for the maj23 block.
+            if self._maj23 is not None and self._maj23.key() == block_key:
+                self._votes[idx] = vote
+                self._votes_bit_array[idx] = True
+            # sum is NOT incremented for a replacement
+        else:
+            self._votes[idx] = vote
+            self._votes_bit_array[idx] = True
+            self._sum += power
+
+        bv = self._votes_by_block.get(block_key)
+        if bv is not None:
+            if conflicting is not None and not bv.peer_maj23:
+                return False, conflicting
+        else:
+            if conflicting is not None:
+                return False, conflicting
+            bv = _BlockVotes(peer_maj23=False, votes=[None] * self.size())
+            self._votes_by_block[block_key] = bv
+
+        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+        orig_sum = bv.sum
+        bv.add_verified(idx, vote, power)
+        if orig_sum < quorum <= bv.sum and self._maj23 is None:
+            self._maj23 = vote.block_id
+            # Promote all votes under this block to canonical.
+            for i, bvote in enumerate(bv.votes):
+                if bvote is not None:
+                    self._votes[i] = bvote
+                    self._votes_bit_array[i] = True
+        return True, conflicting
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """Record a peer's claim that a block has 2/3 (reference:
+        types/vote_set.go:291-330)."""
+        existing = self._peer_maj23s.get(peer_id)
+        if existing is not None and existing != block_id:
+            raise VoteSetError(f"setPeerMaj23: conflicting blockID from peer {peer_id}")
+        self._peer_maj23s[peer_id] = block_id
+        key = block_id.key()
+        bv = self._votes_by_block.get(key)
+        if bv is None:
+            bv = _BlockVotes(peer_maj23=True, votes=[None] * self.size())
+            self._votes_by_block[key] = bv
+        else:
+            bv.peer_maj23 = True
+
+    def make_commit(self):
+        """Build a Commit from 2/3 precommits for a block
+        (reference: types/vote_set.go:578-602 MakeCommit)."""
+        from tendermint_tpu.types.block import Commit, CommitSig
+        from tendermint_tpu.types.basic import BlockIDFlag
+
+        if self.signed_msg_type != SignedMsgType.PRECOMMIT:
+            raise VoteSetError("cannot MakeCommit() unless VoteSet.Type is PRECOMMIT")
+        if self._maj23 is None:
+            raise VoteSetError("cannot MakeCommit() unless a blockhash has +2/3")
+        sigs = []
+        for vote in self._votes:
+            if vote is None:
+                sigs.append(CommitSig.absent_sig())
+            elif vote.block_id == self._maj23:
+                sigs.append(
+                    CommitSig(
+                        BlockIDFlag.COMMIT,
+                        vote.validator_address,
+                        vote.timestamp_ns,
+                        vote.signature,
+                    )
+                )
+            elif vote.block_id.is_zero():
+                sigs.append(
+                    CommitSig(
+                        BlockIDFlag.NIL,
+                        vote.validator_address,
+                        vote.timestamp_ns,
+                        vote.signature,
+                    )
+                )
+            else:
+                # Vote for a different block: counted as absent in the commit.
+                sigs.append(CommitSig.absent_sig())
+        return Commit(self.height, self.round, self._maj23, tuple(sigs))

@@ -11,6 +11,13 @@ import os
 # Force CPU even if the ambient environment points at a TPU (e.g.
 # JAX_PLATFORMS=axon); override with TMTPU_TEST_PLATFORM to test on hardware.
 os.environ["JAX_PLATFORMS"] = os.environ.get("TMTPU_TEST_PLATFORM", "cpu")
+
+# Persistent compilation cache: the ed25519 scan kernel is expensive to compile
+# on CPU; cache it across pytest runs.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

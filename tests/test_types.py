@@ -1,0 +1,269 @@
+"""Types layer: canonical sign-bytes, votes, blocks, part sets, evidence."""
+
+import pytest
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+from tendermint_tpu.crypto import gen_ed25519, tmhash
+from tendermint_tpu.types import canonical
+from tendermint_tpu.types.basic import BlockID, BlockIDFlag, PartSetHeader, SignedMsgType
+from tendermint_tpu.types.block import Block, Commit, CommitSig, ConsensusVersion, Header
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, decode_evidence
+from tendermint_tpu.types.part_set import PartSet, BLOCK_PART_SIZE_BYTES
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+BID = BlockID(hash=b"\xaa" * 32, part_set_header=PartSetHeader(total=3, hash=b"\xbb" * 32))
+
+
+def _canonical_vote_pb_cls():
+    """Dynamic protobuf class for the real CanonicalVote schema."""
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "cv.proto"
+    fdp.package = "cvpkg"
+    fdp.syntax = "proto3"
+
+    psh = fdp.message_type.add()
+    psh.name = "CanonicalPartSetHeader"
+    f = psh.field.add()
+    f.name, f.number, f.type = "total", 1, descriptor_pb2.FieldDescriptorProto.TYPE_UINT32
+    f = psh.field.add()
+    f.name, f.number, f.type = "hash", 2, descriptor_pb2.FieldDescriptorProto.TYPE_BYTES
+
+    bid = fdp.message_type.add()
+    bid.name = "CanonicalBlockID"
+    f = bid.field.add()
+    f.name, f.number, f.type = "hash", 1, descriptor_pb2.FieldDescriptorProto.TYPE_BYTES
+    f = bid.field.add()
+    f.name, f.number, f.type = (
+        "part_set_header",
+        2,
+        descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE,
+    )
+    f.type_name = ".cvpkg.CanonicalPartSetHeader"
+
+    ts = fdp.message_type.add()
+    ts.name = "Ts"
+    f = ts.field.add()
+    f.name, f.number, f.type = "seconds", 1, descriptor_pb2.FieldDescriptorProto.TYPE_INT64
+    f = ts.field.add()
+    f.name, f.number, f.type = "nanos", 2, descriptor_pb2.FieldDescriptorProto.TYPE_INT32
+
+    cv = fdp.message_type.add()
+    cv.name = "CanonicalVote"
+    specs = [
+        ("type", 1, descriptor_pb2.FieldDescriptorProto.TYPE_INT64, None),
+        ("height", 2, descriptor_pb2.FieldDescriptorProto.TYPE_SFIXED64, None),
+        ("round", 3, descriptor_pb2.FieldDescriptorProto.TYPE_SFIXED64, None),
+        ("block_id", 4, descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE, ".cvpkg.CanonicalBlockID"),
+        ("timestamp", 5, descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE, ".cvpkg.Ts"),
+        ("chain_id", 6, descriptor_pb2.FieldDescriptorProto.TYPE_STRING, None),
+    ]
+    for name, num, typ, tn in specs:
+        f = cv.field.add()
+        f.name, f.number, f.type = name, num, typ
+        if tn:
+            f.type_name = tn
+
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fdp)
+    return message_factory.GetMessageClass(pool.FindMessageTypeByName("cvpkg.CanonicalVote"))
+
+
+def test_canonical_vote_bytes_match_protobuf():
+    CV = _canonical_vote_pb_cls()
+    msg = CV()
+    msg.type = int(SignedMsgType.PRECOMMIT)
+    msg.height = 100
+    msg.round = 3
+    msg.block_id.hash = BID.hash
+    msg.block_id.part_set_header.total = 3
+    msg.block_id.part_set_header.hash = BID.part_set_header.hash
+    msg.timestamp.seconds = 1700000000
+    msg.timestamp.nanos = 42
+    msg.chain_id = "test-chain"
+    expected = msg.SerializeToString(deterministic=True)
+
+    got = canonical.canonical_vote_bytes(
+        SignedMsgType.PRECOMMIT, 100, 3, BID, 1700000000 * 10**9 + 42, "test-chain"
+    )
+    assert got == expected
+
+
+def test_canonical_vote_nil_block_omits_blockid():
+    CV = _canonical_vote_pb_cls()
+    msg = CV()
+    msg.type = int(SignedMsgType.PREVOTE)
+    msg.height = 5
+    msg.timestamp.seconds = 10
+    msg.chain_id = "c"
+    expected = msg.SerializeToString(deterministic=True)
+    got = canonical.canonical_vote_bytes(
+        SignedMsgType.PREVOTE, 5, 0, BlockID(), 10 * 10**9, "c"
+    )
+    assert got == expected
+
+
+def test_vote_sign_bytes_are_length_prefixed():
+    sb = canonical.vote_sign_bytes("c", SignedMsgType.PREVOTE, 1, 0, BID, 0)
+    body = canonical.canonical_vote_bytes(SignedMsgType.PREVOTE, 1, 0, BID, 0, "c")
+    assert sb.endswith(body) and len(sb) > len(body)
+
+
+def _make_vote(priv, chain_id="test-chain", height=7, round_=0, block_id=BID, ts=123456789):
+    pub = priv.pub_key()
+    v = Vote(
+        type=SignedMsgType.PRECOMMIT,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp_ns=ts,
+        validator_address=pub.address(),
+        validator_index=0,
+    )
+    return v.with_signature(priv.sign(v.sign_bytes(chain_id)))
+
+
+def test_vote_sign_verify_roundtrip():
+    priv = gen_ed25519(b"\x11" * 32)
+    v = _make_vote(priv)
+    assert v.verify("test-chain", priv.pub_key())
+    assert not v.verify("other-chain", priv.pub_key())
+    other = gen_ed25519(b"\x22" * 32)
+    assert not v.verify("test-chain", other.pub_key())
+    v.validate_basic()
+
+
+def test_vote_encode_decode():
+    priv = gen_ed25519(b"\x11" * 32)
+    v = _make_vote(priv)
+    assert Vote.decode(v.encode()) == v
+
+
+def test_proposal_roundtrip_and_signbytes():
+    p = Proposal(height=10, round=1, pol_round=-1, block_id=BID, timestamp_ns=55)
+    priv = gen_ed25519(b"\x33" * 32)
+    signed = p.with_signature(priv.sign(p.sign_bytes("chain")))
+    signed.validate_basic()
+    assert Proposal.decode(signed.encode()) == signed
+    assert priv.pub_key().verify(p.sign_bytes("chain"), signed.signature)
+
+
+def test_commit_hash_and_roundtrip():
+    priv = gen_ed25519(b"\x44" * 32)
+    cs = CommitSig(BlockIDFlag.COMMIT, priv.pub_key().address(), 99, b"\x01" * 64)
+    commit = Commit(height=5, round=0, block_id=BID, signatures=(cs, CommitSig.absent_sig()))
+    commit.validate_basic()
+    assert len(commit.hash()) == 32
+    assert Commit.decode(commit.encode()) == commit
+    # vote reconstruction
+    vote = commit.get_vote(0)
+    assert vote.height == 5 and vote.block_id == BID
+    # nil/absent sigs resolve to zero block id
+    assert commit.get_vote(1).block_id.is_zero()
+
+
+def test_header_hash_deterministic_and_sensitive():
+    h = Header(
+        version=ConsensusVersion(),
+        chain_id="test",
+        height=3,
+        time_ns=1000,
+        last_block_id=BID,
+        last_commit_hash=b"\x01" * 32,
+        data_hash=b"\x02" * 32,
+        validators_hash=b"\x03" * 32,
+        next_validators_hash=b"\x04" * 32,
+        consensus_hash=b"\x05" * 32,
+        app_hash=b"\x06" * 32,
+        last_results_hash=b"\x07" * 32,
+        evidence_hash=b"\x08" * 32,
+        proposer_address=b"\x09" * 20,
+    )
+    h.validate_basic()
+    h1 = h.hash()
+    assert len(h1) == 32
+    import dataclasses
+
+    h2 = dataclasses.replace(h, height=4).hash()
+    assert h1 != h2
+    assert Header.decode(h.encode()) == h
+
+
+def test_part_set_roundtrip():
+    data = bytes(range(256)) * 1024  # 256 KiB -> 4 parts
+    ps = PartSet.from_data(data)
+    assert ps.total == 4 and ps.is_complete()
+    header = ps.header
+    # Reassemble from gossiped parts
+    ps2 = PartSet(header)
+    assert not ps2.is_complete()
+    for i in range(ps.total):
+        added = ps2.add_part(ps.get_part(i))
+        assert added
+    assert ps2.is_complete()
+    assert ps2.assemble() == data
+
+
+def test_part_set_rejects_bad_proof():
+    data = b"x" * (BLOCK_PART_SIZE_BYTES + 10)
+    ps = PartSet.from_data(data)
+    ps2 = PartSet(ps.header)
+    part = ps.get_part(0)
+    from tendermint_tpu.types.part_set import Part
+
+    bad = Part(part.index, b"tampered" + part.bytes_[8:], part.proof)
+    with pytest.raises(ValueError, match="invalid proof"):
+        ps2.add_part(bad)
+
+
+def test_duplicate_vote_evidence():
+    priv = gen_ed25519(b"\x55" * 32)
+    v1 = _make_vote(priv, block_id=BID)
+    bid2 = BlockID(hash=b"\xcc" * 32, part_set_header=PartSetHeader(total=1, hash=b"\xdd" * 32))
+    v2 = _make_vote(priv, block_id=bid2)
+    ev = DuplicateVoteEvidence.from_votes(v1, v2, block_time_ns=1, total_power=10, val_power=1)
+    ev.validate_basic()
+    ev.verify("test-chain", priv.pub_key())
+    assert decode_evidence(ev.encode()) == ev
+    # same-block "evidence" is invalid
+    with pytest.raises(ValueError):
+        ev_same = DuplicateVoteEvidence.from_votes(v1, v1, 1, 10, 1)
+        ev_same.verify("test-chain", priv.pub_key())
+    # wrong pubkey
+    with pytest.raises(ValueError):
+        ev.verify("test-chain", gen_ed25519(b"\x66" * 32).pub_key())
+
+
+def test_block_validate_basic():
+    txs = (b"tx1", b"tx2")
+    priv = gen_ed25519(b"\x77" * 32)
+    cs = CommitSig(BlockIDFlag.COMMIT, priv.pub_key().address(), 5, b"\x01" * 64)
+    last_commit = Commit(height=2, round=0, block_id=BID, signatures=(cs,))
+    from tendermint_tpu.types.block import txs_hash
+    from tendermint_tpu.crypto.merkle import hash_from_byte_slices
+
+    header = Header(
+        version=ConsensusVersion(),
+        chain_id="test",
+        height=3,
+        time_ns=1000,
+        last_block_id=BID,
+        last_commit_hash=last_commit.hash(),
+        data_hash=txs_hash(txs),
+        validators_hash=b"\x03" * 32,
+        next_validators_hash=b"\x04" * 32,
+        consensus_hash=b"\x05" * 32,
+        app_hash=b"\x06" * 32,
+        last_results_hash=b"\x07" * 32,
+        evidence_hash=hash_from_byte_slices([]),
+        proposer_address=b"\x09" * 20,
+    )
+    block = Block(header, txs, (), last_commit)
+    block.validate_basic()
+    assert Block.decode(block.encode()) == block
+    # tampered data hash fails
+    import dataclasses
+
+    bad = Block(dataclasses.replace(header, data_hash=b"\x00" * 32), txs, (), last_commit)
+    with pytest.raises(ValueError, match="DataHash"):
+        bad.validate_basic()

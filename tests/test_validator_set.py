@@ -1,0 +1,350 @@
+"""ValidatorSet: proposer rotation, updates, batched commit verification."""
+
+from fractions import Fraction
+
+import pytest
+
+from tendermint_tpu.crypto import gen_ed25519
+from tendermint_tpu.types.basic import BlockID, BlockIDFlag, PartSetHeader, SignedMsgType
+from tendermint_tpu.types.block import CommitSig
+from tendermint_tpu.types.validator_set import (
+    CommitVerifyError,
+    NotEnoughVotingPowerError,
+    Validator,
+    ValidatorSet,
+)
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import ConflictingVotesError, VoteSet
+
+CHAIN = "test-chain"
+BID = BlockID(hash=b"\xaa" * 32, part_set_header=PartSetHeader(total=2, hash=b"\xbb" * 32))
+
+
+def make_vals(n, power=10):
+    privs = [gen_ed25519(bytes([i + 1]) * 32) for i in range(n)]
+    vals = [Validator(p.pub_key(), power) for p in privs]
+    vs = ValidatorSet(vals)
+    # map privs to sorted order
+    by_addr = {p.pub_key().address(): p for p in privs}
+    sorted_privs = [by_addr[v.address] for v in vs.validators]
+    return vs, sorted_privs
+
+
+def test_sorting_and_lookup():
+    vs, privs = make_vals(5)
+    addrs = [v.address for v in vs.validators]
+    assert addrs == sorted(addrs)  # equal power -> sorted by address
+    idx, val = vs.get_by_address(addrs[2])
+    assert idx == 2 and val.address == addrs[2]
+    assert vs.total_voting_power() == 50
+    assert vs.has_address(addrs[0]) and not vs.has_address(b"\x00" * 20)
+
+
+def test_proposer_rotation_equal_power():
+    vs, _ = make_vals(4)
+    seen = []
+    for _ in range(8):
+        vs.increment_proposer_priority(1)
+        seen.append(vs.get_proposer().address)
+    # with equal power every validator proposes once per 4 rounds
+    assert set(seen[:4]) == set(v.address for v in vs.validators)
+    assert seen[:4] == seen[4:8]
+
+
+def test_proposer_weighted():
+    a = gen_ed25519(b"\x01" * 32).pub_key()
+    b = gen_ed25519(b"\x02" * 32).pub_key()
+    vs = ValidatorSet([Validator(a, 3), Validator(b, 1)])
+    counts = {}
+    for _ in range(40):
+        vs.increment_proposer_priority(1)
+        addr = vs.get_proposer().address
+        counts[addr] = counts.get(addr, 0) + 1
+    assert counts[a.address()] == 30
+    assert counts[b.address()] == 10
+
+
+def test_priorities_centered():
+    vs, _ = make_vals(7, power=100)
+    for _ in range(50):
+        vs.increment_proposer_priority(1)
+    total = sum(v.proposer_priority for v in vs.validators)
+    # centered around zero, bounded by 2*total power window
+    assert abs(total) <= vs.total_voting_power() * 2 * len(vs.validators)
+
+
+def test_copy_increment_does_not_mutate():
+    vs, _ = make_vals(3)
+    before = [(v.address, v.proposer_priority) for v in vs.validators]
+    vs2 = vs.copy_increment_proposer_priority(3)
+    after = [(v.address, v.proposer_priority) for v in vs.validators]
+    assert before == after
+    assert vs2 is not vs
+
+
+def test_updates_add_remove():
+    vs, _ = make_vals(3, power=10)
+    new_priv = gen_ed25519(b"\x09" * 32)
+    vs.update_with_change_set([Validator(new_priv.pub_key(), 5)])
+    assert vs.size() == 4
+    assert vs.total_voting_power() == 35
+    # new validator got the -1.125*total penalty -> not immediately proposer
+    _, nv = vs.get_by_address(new_priv.pub_key().address())
+    assert nv.voting_power == 5
+    # remove it
+    vs.update_with_change_set([Validator(new_priv.pub_key(), 0)])
+    assert vs.size() == 3 and vs.total_voting_power() == 30
+    # removing an unknown validator errors
+    with pytest.raises(ValueError, match="failed to find"):
+        vs.update_with_change_set([Validator(new_priv.pub_key(), 0)])
+    # power update
+    target = vs.validators[0]
+    vs.update_with_change_set([Validator(target.pub_key, 42)])
+    assert vs.total_voting_power() == 42 + 20
+
+
+def test_hash_changes_with_set():
+    vs, _ = make_vals(3)
+    h1 = vs.hash()
+    vs.update_with_change_set([Validator(gen_ed25519(b"\x0a" * 32).pub_key(), 7)])
+    assert vs.hash() != h1
+
+
+def _signed_commit(vs, privs, height=5, round_=0, block_id=BID, nil_idx=(), absent_idx=(), bad_idx=()):
+    sigs = []
+    for i, (val, priv) in enumerate(zip(vs.validators, privs)):
+        if i in absent_idx:
+            sigs.append(CommitSig.absent_sig())
+            continue
+        bid = BlockID() if i in nil_idx else block_id
+        flag = BlockIDFlag.NIL if i in nil_idx else BlockIDFlag.COMMIT
+        v = Vote(
+            type=SignedMsgType.PRECOMMIT,
+            height=height,
+            round=round_,
+            block_id=bid,
+            timestamp_ns=1000 + i,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        sig = priv.sign(v.sign_bytes(CHAIN))
+        if i in bad_idx:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        sigs.append(CommitSig(flag, val.address, v.timestamp_ns, sig))
+    from tendermint_tpu.types.block import Commit
+
+    return Commit(height, round_, block_id, tuple(sigs))
+
+
+def test_verify_commit_ok():
+    vs, privs = make_vals(6)
+    commit = _signed_commit(vs, privs)
+    vs.verify_commit(CHAIN, BID, 5, commit)
+    vs.verify_commit_light(CHAIN, BID, 5, commit)
+    vs.verify_commit_light_trusting(CHAIN, commit, Fraction(1, 3))
+
+
+def test_verify_commit_with_nil_and_absent():
+    vs, privs = make_vals(6)
+    commit = _signed_commit(vs, privs, nil_idx=(1,))
+    vs.verify_commit(CHAIN, BID, 5, commit)  # 5/6 voting for block > 2/3
+    # exactly 2/3 (4 of 6) is NOT enough: threshold is strict
+    commit2 = _signed_commit(vs, privs, nil_idx=(1,), absent_idx=(2,))
+    with pytest.raises(NotEnoughVotingPowerError):
+        vs.verify_commit(CHAIN, BID, 5, commit2)
+
+
+def test_verify_commit_insufficient_power():
+    vs, privs = make_vals(6)
+    commit = _signed_commit(vs, privs, nil_idx=(0, 1), absent_idx=(2,))
+    with pytest.raises(NotEnoughVotingPowerError):
+        vs.verify_commit(CHAIN, BID, 5, commit)
+
+
+def test_verify_commit_bad_signature():
+    vs, privs = make_vals(4)
+    commit = _signed_commit(vs, privs, bad_idx=(3,))
+    with pytest.raises(CommitVerifyError, match="wrong signature"):
+        vs.verify_commit(CHAIN, BID, 5, commit)
+
+
+def test_verify_commit_wrong_height_blockid_size():
+    vs, privs = make_vals(4)
+    commit = _signed_commit(vs, privs)
+    with pytest.raises(CommitVerifyError, match="height"):
+        vs.verify_commit(CHAIN, BID, 6, commit)
+    other = BlockID(hash=b"\xee" * 32, part_set_header=PartSetHeader(1, b"\xff" * 32))
+    with pytest.raises(CommitVerifyError, match="block ID"):
+        vs.verify_commit(CHAIN, other, 5, commit)
+    small, _ = make_vals(3)
+    with pytest.raises(CommitVerifyError, match="set size"):
+        small.verify_commit(CHAIN, BID, 5, commit)
+
+
+def test_verify_commit_light_trusting_different_set():
+    vs, privs = make_vals(6)
+    commit = _signed_commit(vs, privs)
+    # trusted set = subset with extra unknown validator
+    extra = Validator(gen_ed25519(b"\x0b" * 32).pub_key(), 10)
+    trusted = ValidatorSet([Validator(v.pub_key, v.voting_power) for v in vs.validators[:4]] + [extra])
+    trusted.verify_commit_light_trusting(CHAIN, commit, Fraction(1, 3))
+    with pytest.raises(NotEnoughVotingPowerError):
+        trusted.verify_commit_light_trusting(CHAIN, commit, Fraction(9, 10))
+
+
+def test_vote_set_two_thirds():
+    vs, privs = make_vals(4)
+    vote_set = VoteSet(CHAIN, 5, 0, SignedMsgType.PRECOMMIT, vs)
+    for i, (val, priv) in enumerate(zip(vs.validators, privs)):
+        v = Vote(
+            type=SignedMsgType.PRECOMMIT,
+            height=5,
+            round=0,
+            block_id=BID,
+            timestamp_ns=1000,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        v = v.with_signature(priv.sign(v.sign_bytes(CHAIN)))
+        assert vote_set.add_vote(v)
+        if i < 2:
+            assert not vote_set.has_two_thirds_majority()
+    assert vote_set.has_two_thirds_majority()
+    assert vote_set.two_thirds_majority() == BID
+    commit = vote_set.make_commit()
+    vs.verify_commit(CHAIN, BID, 5, commit)
+
+
+def test_vote_set_rejects_invalid():
+    vs, privs = make_vals(3)
+    vote_set = VoteSet(CHAIN, 5, 0, SignedMsgType.PRECOMMIT, vs)
+    val, priv = vs.validators[0], privs[0]
+    v = Vote(
+        type=SignedMsgType.PRECOMMIT,
+        height=5,
+        round=0,
+        block_id=BID,
+        timestamp_ns=0,
+        validator_address=val.address,
+        validator_index=0,
+    )
+    signed = v.with_signature(priv.sign(v.sign_bytes(CHAIN)))
+    # wrong height
+    import dataclasses
+
+    from tendermint_tpu.types.vote_set import VoteSetError
+
+    with pytest.raises(VoteSetError, match="expected"):
+        vote_set.add_vote(dataclasses.replace(signed, height=6))
+    # bad signature
+    with pytest.raises(VoteSetError, match="invalid signature"):
+        vote_set.add_vote(v.with_signature(b"\x00" * 64))
+    # good vote then duplicate
+    assert vote_set.add_vote(signed)
+    assert not vote_set.add_vote(signed)
+
+
+def test_vote_set_conflict_detection():
+    vs, privs = make_vals(3)
+    vote_set = VoteSet(CHAIN, 5, 0, SignedMsgType.PRECOMMIT, vs)
+    val, priv = vs.validators[0], privs[0]
+
+    def mk(bid):
+        v = Vote(
+            type=SignedMsgType.PRECOMMIT,
+            height=5,
+            round=0,
+            block_id=bid,
+            timestamp_ns=0,
+            validator_address=val.address,
+            validator_index=0,
+        )
+        return v.with_signature(priv.sign(v.sign_bytes(CHAIN)))
+
+    assert vote_set.add_vote(mk(BID))
+    other = BlockID(hash=b"\xcc" * 32, part_set_header=PartSetHeader(1, b"\xdd" * 32))
+    with pytest.raises(ConflictingVotesError):
+        vote_set.add_vote(mk(other))
+
+
+def test_vote_set_deferred_batch_flush():
+    vs, privs = make_vals(4)
+    vote_set = VoteSet(CHAIN, 5, 0, SignedMsgType.PRECOMMIT, vs, defer_verification=True)
+    for i, (val, priv) in enumerate(zip(vs.validators, privs)):
+        v = Vote(
+            type=SignedMsgType.PRECOMMIT,
+            height=5,
+            round=0,
+            block_id=BID,
+            timestamp_ns=0,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        sig = priv.sign(v.sign_bytes(CHAIN))
+        if i == 2:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]  # corrupt one
+        vote_set.add_vote(v.with_signature(sig))
+    assert not vote_set.has_two_thirds_majority()  # nothing committed yet
+    failed = vote_set.flush()
+    assert failed == [2]
+    assert vote_set.has_two_thirds_majority()  # 3/4 valid > 2/3
+
+
+def test_vote_set_deferred_detects_equivocation():
+    vs, privs = make_vals(4)
+    vote_set = VoteSet(CHAIN, 5, 0, SignedMsgType.PRECOMMIT, vs, defer_verification=True)
+    val, priv = vs.validators[0], privs[0]
+
+    def mk(bid, i=0):
+        v = Vote(
+            type=SignedMsgType.PRECOMMIT, height=5, round=0, block_id=bid,
+            timestamp_ns=0, validator_address=vs.validators[i].address, validator_index=i,
+        )
+        return v.with_signature(privs[i].sign(v.sign_bytes(CHAIN)))
+
+    other = BlockID(hash=b"\xcc" * 32, part_set_header=PartSetHeader(1, b"\xdd" * 32))
+    v1, v2 = mk(BID), mk(other)
+    assert vote_set.add_vote(v1)
+    assert not vote_set.add_vote(v1)  # duplicate detected while pending
+    assert vote_set.add_vote(v2)  # queued; conflict surfaces at flush
+    assert vote_set.flush() == []
+    conflicts = vote_set.pop_conflicts()
+    assert len(conflicts) == 1
+    assert {conflicts[0].vote_a.block_id, conflicts[0].vote_b.block_id} == {BID, other}
+    assert vote_set.pop_conflicts() == []
+
+
+def test_vote_set_peer_maj23_tracks_conflicting_votes():
+    # Mirrors reference behavior: a conflicting vote for a peer-claimed-maj23
+    # block is still tallied under that block and can produce the 2/3 majority.
+    vs, privs = make_vals(4)
+    vote_set = VoteSet(CHAIN, 5, 0, SignedMsgType.PRECOMMIT, vs)
+
+    def mk(i, bid):
+        v = Vote(
+            type=SignedMsgType.PRECOMMIT, height=5, round=0, block_id=bid,
+            timestamp_ns=0, validator_address=vs.validators[i].address, validator_index=i,
+        )
+        return v.with_signature(privs[i].sign(v.sign_bytes(CHAIN)))
+
+    nil = BlockID()
+    vote_set.set_peer_maj23("peer1", BID)
+    # validator 0 votes nil first, then equivocates with a vote for BID
+    assert vote_set.add_vote(mk(0, nil))
+    with pytest.raises(ConflictingVotesError):
+        vote_set.add_vote(mk(0, BID))
+    # the conflicting vote was tracked under BID: it counts toward the 2/3,
+    # so only 2 more votes are needed (10+10+10 = 30 > 2/3*40)
+    assert vote_set.add_vote(mk(1, BID))
+    assert not vote_set.has_two_thirds_majority()
+    assert vote_set.add_vote(mk(2, BID))
+    assert vote_set.has_two_thirds_majority()
+    assert vote_set.two_thirds_majority() == BID
+
+
+def test_update_with_change_set_does_not_mutate_caller():
+    vs, _ = make_vals(3)
+    new_val = Validator(gen_ed25519(b"\x0c" * 32).pub_key(), 5)
+    assert new_val.proposer_priority == 0
+    vs.update_with_change_set([new_val])
+    assert new_val.proposer_priority == 0  # caller's object untouched
