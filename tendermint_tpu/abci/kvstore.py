@@ -1,0 +1,163 @@
+"""In-process example applications (reference: abci/example/kvstore, counter).
+
+KVStoreApplication: key=value transactions, app hash = big-endian encoded tx
+count (mirrors the reference's size-based app hash, abci/example/kvstore/kvstore.go:66).
+PersistentKVStoreApplication adds validator-update txs ("val:pubkeyhex!power")
+and height persistence for handshake/replay testing.
+CounterApplication: serial nonce check (abci/example/counter/counter.go:11).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.libs.kvdb import KVDB, MemDB
+
+VALIDATOR_TX_PREFIX = b"val:"
+
+
+class KVStoreApplication(abci.Application):
+    def __init__(self, db: Optional[KVDB] = None):
+        self.db = db or MemDB()
+        self.size = int.from_bytes(self.db.get(b"__size__") or b"\x00", "big")
+        self.height = int.from_bytes(self.db.get(b"__height__") or b"\x00", "big")
+        self.app_hash = self.db.get(b"__apphash__") or b""
+        self.staged: List[tuple] = []
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=json.dumps({"size": self.size}),
+            version="0.1.0",
+            app_version=1,
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if not req.tx:
+            return abci.ResponseCheckTx(code=1, log="empty tx")
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        if b"=" in req.tx:
+            key, value = req.tx.split(b"=", 1)
+        else:
+            key = value = req.tx
+        self.staged.append((key, value))
+        events = [
+            abci.Event(
+                type="app",
+                attributes=[(b"creator", b"tendermint_tpu", True), (b"key", key, True)],
+            )
+        ]
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK, events=events)
+
+    def commit(self) -> abci.ResponseCommit:
+        for key, value in self.staged:
+            self.db.set(b"kv/" + key, value)
+            self.size += 1
+        self.staged.clear()
+        self.height += 1
+        # app hash = encoded size (mirrors reference kvstore.go:113)
+        self.app_hash = struct.pack(">Q", self.size)
+        self.db.set(b"__size__", self.size.to_bytes(8, "big"))
+        self.db.set(b"__height__", self.height.to_bytes(8, "big"))
+        self.db.set(b"__apphash__", self.app_hash)
+        return abci.ResponseCommit(data=self.app_hash)
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        if req.path == "/store" or req.path == "":
+            value = self.db.get(b"kv/" + req.data)
+            return abci.ResponseQuery(
+                code=abci.CODE_TYPE_OK,
+                key=req.data,
+                value=value or b"",
+                height=self.height,
+                log="exists" if value is not None else "does not exist",
+            )
+        return abci.ResponseQuery(code=1, log=f"unknown path {req.path}")
+
+
+class PersistentKVStoreApplication(KVStoreApplication):
+    """Adds validator updates via "val:<pubkey_hex>!<power>" txs
+    (reference: abci/example/kvstore/persistent_kvstore.go)."""
+
+    def __init__(self, db: Optional[KVDB] = None):
+        super().__init__(db)
+        self.val_updates: List[abci.ValidatorUpdate] = []
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        for v in req.validators:
+            self._set_validator(v)
+        return abci.ResponseInitChain()
+
+    def _set_validator(self, v: abci.ValidatorUpdate) -> None:
+        key = b"valkey/" + v.pub_key_bytes
+        if v.power == 0:
+            self.db.delete(key)
+        else:
+            self.db.set(key, str(v.power).encode())
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        if req.tx.startswith(VALIDATOR_TX_PREFIX):
+            body = req.tx[len(VALIDATOR_TX_PREFIX):]
+            try:
+                pubkey_hex, power_s = body.split(b"!", 1)
+                pubkey = bytes.fromhex(pubkey_hex.decode())
+                power = int(power_s)
+            except Exception:
+                return abci.ResponseDeliverTx(code=2, log="invalid validator tx")
+            if len(pubkey) != 32 or power < 0:
+                return abci.ResponseDeliverTx(code=2, log="invalid validator tx")
+            update = abci.ValidatorUpdate("ed25519", pubkey, power)
+            self.val_updates.append(update)
+            self._set_validator(update)
+            return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+        return super().deliver_tx(req)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        updates, self.val_updates = self.val_updates, []
+        return abci.ResponseEndBlock(validator_updates=updates)
+
+
+class CounterApplication(abci.Application):
+    """Serial-nonce app (reference: abci/example/counter/counter.go)."""
+
+    def __init__(self, serial: bool = True):
+        self.serial = serial
+        self.tx_count = 0
+        self.height = 0
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=f"txs:{self.tx_count}", last_block_height=self.height,
+            last_block_app_hash=(
+                struct.pack(">Q", self.tx_count) if self.height else b""
+            ),
+        )
+
+    def _check_value(self, tx: bytes, expected: int) -> bool:
+        if len(tx) > 8:
+            return False
+        value = int.from_bytes(tx, "big")
+        return value == expected
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if self.serial and not self._check_value(req.tx, self.tx_count):
+            return abci.ResponseCheckTx(code=2, log="invalid nonce")
+        return abci.ResponseCheckTx()
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        if self.serial and not self._check_value(req.tx, self.tx_count):
+            return abci.ResponseDeliverTx(code=2, log="invalid nonce")
+        self.tx_count += 1
+        return abci.ResponseDeliverTx()
+
+    def commit(self) -> abci.ResponseCommit:
+        self.height += 1
+        if self.tx_count == 0:
+            return abci.ResponseCommit()
+        return abci.ResponseCommit(data=struct.pack(">Q", self.tx_count))

@@ -1,0 +1,247 @@
+"""Consensus write-ahead log (reference: consensus/wal.go).
+
+Every message (peer msg, internal msg, timeout) is written before processing;
+self-generated messages are fsynced (WriteSync). Framing: crc32(IEEE) ‖
+length ‖ protobuf body (reference: consensus/wal.go:290 WALEncoder), with
+rotating files via a size-capped group (reference: libs/autofile/group.go).
+EndHeightMessage marks a completed height for crash replay
+(reference: consensus/wal.go:42,231)."""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple, Union
+
+from tendermint_tpu.consensus.messages import decode_message, encode_message
+from tendermint_tpu.libs import protowire as pw
+
+MAX_MSG_SIZE_BYTES = 1024 * 1024  # 1MB (reference: consensus/wal.go:32)
+DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024  # autofile group head limit
+DEFAULT_GROUP_TOTAL_LIMIT = 1024 * 1024 * 1024
+
+
+@dataclass(frozen=True)
+class EndHeightMessage:
+    height: int
+
+
+@dataclass(frozen=True)
+class TimeoutInfo:
+    duration_s: float
+    height: int
+    round: int
+    step: int
+
+
+@dataclass(frozen=True)
+class MsgInfo:
+    msg: object  # a consensus message
+    peer_id: str = ""
+
+
+@dataclass(frozen=True)
+class EventRoundState:
+    height: int
+    round: int
+    step: int
+
+
+WALMessage = Union[EndHeightMessage, TimeoutInfo, MsgInfo, EventRoundState]
+
+
+def _encode_wal_message(msg: WALMessage) -> bytes:
+    w = pw.Writer()
+    if isinstance(msg, EndHeightMessage):
+        w.varint_field(1, msg.height, emit_zero=True)
+    elif isinstance(msg, TimeoutInfo):
+        body = pw.Writer()
+        body.varint_field(1, int(msg.duration_s * 1e9))
+        body.varint_field(2, msg.height)
+        body.varint_field(3, msg.round)
+        body.varint_field(4, msg.step)
+        w.message_field(2, body.bytes(), always=True)
+    elif isinstance(msg, MsgInfo):
+        body = pw.Writer()
+        body.bytes_field(1, msg.peer_id.encode())
+        body.message_field(2, encode_message(msg.msg), always=True)
+        w.message_field(3, body.bytes(), always=True)
+    elif isinstance(msg, EventRoundState):
+        body = pw.Writer()
+        body.varint_field(1, msg.height)
+        body.varint_field(2, msg.round)
+        body.varint_field(3, msg.step)
+        w.message_field(4, body.bytes(), always=True)
+    else:
+        raise TypeError(f"unknown WAL message {type(msg)}")
+    return w.bytes()
+
+
+def _decode_wal_message(data: bytes) -> WALMessage:
+    for f, _, v in pw.Reader(data):
+        if f == 1:
+            return EndHeightMessage(pw.int64_from_varint(v))
+        if f == 2:
+            vals = [0, 0, 0, 0]
+            for ff, _, vv in pw.Reader(v):
+                if 1 <= ff <= 4:
+                    vals[ff - 1] = pw.int64_from_varint(vv)
+            return TimeoutInfo(vals[0] / 1e9, vals[1], vals[2], vals[3])
+        if f == 3:
+            peer = ""
+            inner = None
+            for ff, _, vv in pw.Reader(v):
+                if ff == 1:
+                    peer = vv.decode()
+                elif ff == 2:
+                    inner = decode_message(vv)
+            return MsgInfo(inner, peer)
+        if f == 4:
+            vals = [0, 0, 0]
+            for ff, _, vv in pw.Reader(v):
+                if 1 <= ff <= 3:
+                    vals[ff - 1] = pw.int64_from_varint(vv)
+            return EventRoundState(*vals)
+    raise ValueError("empty WAL message")
+
+
+class CorruptedWALError(Exception):
+    pass
+
+
+class WAL:
+    """Size-rotated WAL. Files: <path>, <path>.000, <path>.001 … (rotated
+    heads); head is always <path>."""
+
+    def __init__(
+        self,
+        path: str,
+        head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT,
+        total_size_limit: int = DEFAULT_GROUP_TOTAL_LIMIT,
+    ):
+        self.path = path
+        self.head_size_limit = head_size_limit
+        self.total_size_limit = total_size_limit
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "ab")
+        self._flushed = True
+
+    # -- writing ------------------------------------------------------------
+
+    def _frame(self, msg: WALMessage) -> bytes:
+        body = _encode_wal_message(msg)
+        if len(body) > MAX_MSG_SIZE_BYTES:
+            raise ValueError(f"msg is too big: {len(body)} bytes")
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        return struct.pack(">II", crc, len(body)) + body
+
+    def write(self, msg: WALMessage) -> None:
+        """(reference: consensus/wal.go:184 Write — async, no fsync)"""
+        self._fh.write(self._frame(msg))
+        self._flushed = False
+        self._maybe_rotate()
+
+    def write_sync(self, msg: WALMessage) -> None:
+        """(reference: consensus/wal.go:201 WriteSync — fsync before returning)"""
+        self._fh.write(self._frame(msg))
+        self.flush_and_sync()
+        self._maybe_rotate()
+
+    def flush_and_sync(self) -> None:
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._flushed = True
+
+    def write_end_height(self, height: int) -> None:
+        self.write_sync(EndHeightMessage(height))
+
+    def _maybe_rotate(self) -> None:
+        if self._fh.tell() < self.head_size_limit:
+            return
+        self.flush_and_sync()
+        self._fh.close()
+        # shift: find next rotation index
+        idx = 0
+        while os.path.exists(f"{self.path}.{idx:03d}"):
+            idx += 1
+        os.replace(self.path, f"{self.path}.{idx:03d}")
+        self._fh = open(self.path, "ab")
+        self._enforce_total_limit(idx)
+
+    def _enforce_total_limit(self, latest_idx: int) -> None:
+        files = [f"{self.path}.{i:03d}" for i in range(latest_idx + 1)]
+        files = [f for f in files if os.path.exists(f)]
+        total = sum(os.path.getsize(f) for f in files)
+        for f in files:
+            if total <= self.total_size_limit:
+                break
+            total -= os.path.getsize(f)
+            os.unlink(f)
+
+    def close(self) -> None:
+        try:
+            self.flush_and_sync()
+        finally:
+            self._fh.close()
+
+    # -- reading ------------------------------------------------------------
+
+    def _all_files(self) -> List[str]:
+        files = []
+        idx = 0
+        while os.path.exists(f"{self.path}.{idx:03d}"):
+            files.append(f"{self.path}.{idx:03d}")
+            idx += 1
+        if os.path.exists(self.path):
+            files.append(self.path)
+        return files
+
+    def iter_messages(self, strict: bool = False) -> Iterator[WALMessage]:
+        """Decode all messages across rotated files. Non-strict mode stops at
+        the first corrupted frame (torn write at crash)."""
+        for fname in self._all_files():
+            with open(fname, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos < len(data):
+                if pos + 8 > len(data):
+                    if strict:
+                        raise CorruptedWALError("truncated frame header")
+                    return
+                crc, length = struct.unpack_from(">II", data, pos)
+                if length > MAX_MSG_SIZE_BYTES:
+                    if strict:
+                        raise CorruptedWALError("frame too large")
+                    return
+                if pos + 8 + length > len(data):
+                    if strict:
+                        raise CorruptedWALError("truncated frame body")
+                    return
+                body = data[pos + 8 : pos + 8 + length]
+                if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                    if strict:
+                        raise CorruptedWALError("crc mismatch")
+                    return
+                try:
+                    yield _decode_wal_message(body)
+                except ValueError:
+                    if strict:
+                        raise CorruptedWALError("undecodable message")
+                    return
+                pos += 8 + length
+
+    def search_for_end_height(self, height: int) -> Optional[List[WALMessage]]:
+        """Returns messages AFTER EndHeightMessage(height), or None if the
+        marker is absent (reference: consensus/wal.go:231)."""
+        found = False
+        out: List[WALMessage] = []
+        for msg in self.iter_messages():
+            if isinstance(msg, EndHeightMessage) and msg.height == height:
+                found = True
+                out = []
+                continue
+            if found:
+                out.append(msg)
+        return out if found else None

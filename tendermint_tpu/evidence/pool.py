@@ -1,0 +1,123 @@
+"""Evidence pool (reference: evidence/pool.go:26).
+
+Stores pending DuplicateVoteEvidence in the db, verifies on add
+(age by height+time vs ConsensusParams.Evidence, validator membership, the two
+conflicting sigs — reference: evidence/verify.go:15), marks committed on
+update, and serves PendingEvidence for proposals."""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+from tendermint_tpu.libs.kvdb import KVDB
+from tendermint_tpu.state.sm_state import State
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, decode_evidence
+
+
+class EvidenceError(Exception):
+    pass
+
+
+def _pending_key(ev) -> bytes:
+    return b"EV:pending:" + struct.pack(">q", ev.height) + ev.hash()
+
+
+def _committed_key(ev) -> bytes:
+    return b"EV:committed:" + struct.pack(">q", ev.height) + ev.hash()
+
+
+class EvidencePool:
+    def __init__(self, db: KVDB, state_store, block_store):
+        self.db = db
+        self.state_store = state_store
+        self.block_store = block_store
+        self._state: Optional[State] = None
+
+    def set_state(self, state: State) -> None:
+        self._state = state
+
+    # -- queries ------------------------------------------------------------
+
+    def pending_evidence(self, max_bytes: int) -> List[DuplicateVoteEvidence]:
+        out: List[DuplicateVoteEvidence] = []
+        size = 0
+        for _, raw in self.db.iterate_prefix(b"EV:pending:"):
+            ev = decode_evidence(raw)
+            size += len(raw)
+            if max_bytes >= 0 and size > max_bytes:
+                break
+            out.append(ev)
+        return out
+
+    def is_committed(self, ev) -> bool:
+        return self.db.has(_committed_key(ev))
+
+    def is_pending(self, ev) -> bool:
+        return self.db.has(_pending_key(ev))
+
+    # -- verification -------------------------------------------------------
+
+    def _is_expired(self, state: State, height: int, time_ns: int) -> bool:
+        """(reference: evidence/pool.go isExpired)"""
+        params = state.consensus_params.evidence
+        age_blocks = state.last_block_height - height
+        age_ns = state.last_block_time_ns - time_ns
+        return age_blocks > params.max_age_num_blocks and age_ns > params.max_age_duration_ns
+
+    def check_evidence(self, state: State, ev) -> None:
+        """Verify evidence against a given state (used by block validation)."""
+        if not isinstance(ev, DuplicateVoteEvidence):
+            raise EvidenceError(f"unknown evidence type {type(ev)}")
+        if self.is_committed(ev):
+            raise EvidenceError("evidence was already committed")
+        ev.validate_basic()
+        if self._is_expired(state, ev.height, ev.timestamp_ns):
+            raise EvidenceError("evidence is expired")
+        vals = self.state_store.load_validators(ev.height)
+        if vals is None:
+            raise EvidenceError(f"no validator set at evidence height {ev.height}")
+        _, val = vals.get_by_address(ev.address())
+        if val is None:
+            raise EvidenceError("validator in evidence is not in the validator set")
+        ev.verify(state.chain_id, val.pub_key)
+        # power consistency (reference: evidence/verify.go)
+        if ev.validator_power != val.voting_power:
+            raise EvidenceError(
+                f"evidence validator power {ev.validator_power} != {val.voting_power}"
+            )
+        if ev.total_voting_power != vals.total_voting_power():
+            raise EvidenceError("evidence total voting power mismatch")
+
+    # -- mutations ----------------------------------------------------------
+
+    def add_evidence(self, ev) -> None:
+        """(reference: evidence/pool.go:118 AddEvidence)"""
+        if self._state is None:
+            raise EvidenceError("evidence pool has no state")
+        if self.is_pending(ev) or self.is_committed(ev):
+            return
+        self.check_evidence(self._state, ev)
+        self.db.set(_pending_key(ev), ev.encode())
+
+    def add_evidence_from_consensus(self, ev, time_ns: int, val_set) -> None:
+        """Evidence discovered locally by consensus (conflicting votes)
+        (reference: evidence/pool.go AddEvidenceFromConsensus)."""
+        if self.is_pending(ev) or self.is_committed(ev):
+            return
+        self.db.set(_pending_key(ev), ev.encode())
+
+    def update(self, state: State, committed_evidence) -> None:
+        """Mark committed, drop expired (reference: evidence/pool.go:91)."""
+        self._state = state
+        for ev in committed_evidence:
+            self.db.set(_committed_key(ev), b"\x01")
+            self.db.delete(_pending_key(ev))
+        # prune expired pending
+        deletes = []
+        for key, raw in self.db.iterate_prefix(b"EV:pending:"):
+            ev = decode_evidence(raw)
+            if self._is_expired(state, ev.height, ev.timestamp_ns):
+                deletes.append(key)
+        if deletes:
+            self.db.write_batch([], deletes)

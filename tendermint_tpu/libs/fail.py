@@ -1,0 +1,36 @@
+"""Fail-point crash injection (reference: libs/fail/fail.go).
+
+Set TMTPU_FAIL_INDEX=<n>; the n-th fail point hit in the process aborts it
+hard (os._exit), simulating a crash at that exact ordering point. Used by the
+crash-recovery test matrix around the commit/apply sequence
+(reference: state/execution.go:143-189, consensus/state.go:746,
+test/persist/test_failure_indices.sh)."""
+
+from __future__ import annotations
+
+import os
+
+_counter = 0
+
+
+def fail_index() -> int:
+    try:
+        return int(os.environ.get("TMTPU_FAIL_INDEX", "-1"))
+    except ValueError:
+        return -1
+
+
+def reset() -> None:
+    global _counter
+    _counter = 0
+
+
+def fail_point(name: str = "") -> None:
+    global _counter
+    target = fail_index()
+    if target < 0:
+        return
+    if _counter == target:
+        os.write(2, f"FAIL_POINT {_counter} {name}: crashing\n".encode())
+        os._exit(77)
+    _counter += 1

@@ -1,0 +1,165 @@
+"""Query-indexed pub/sub (reference: libs/pubsub/pubsub.go:91 + query DSL).
+
+Events are (type, attributes) maps; subscriptions carry a Query that matches
+composite key=value conditions. The query language supports the subset the
+reference RPC actually uses: `key = 'value'`, `key = value`, conjunctions with
+AND, and the numeric comparisons =, <, <=, >, >= plus CONTAINS and EXISTS."""
+
+from __future__ import annotations
+
+import asyncio
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+_CONDITION_RE = re.compile(
+    r"\s*([\w.]+)\s*(=|<=|>=|<|>|CONTAINS|EXISTS)\s*('(?:[^']*)'|\"(?:[^\"]*)\"|[\w.\-+]+)?\s*"
+)
+
+
+@dataclass(frozen=True)
+class Condition:
+    key: str
+    op: str
+    value: str = ""
+
+
+class Query:
+    """Parsed conjunction of conditions."""
+
+    def __init__(self, query_str: str):
+        self.query_str = query_str.strip()
+        self.conditions: List[Condition] = []
+        if self.query_str:
+            for clause in self.query_str.split(" AND "):
+                m = _CONDITION_RE.fullmatch(clause)
+                if not m:
+                    raise ValueError(f"invalid query clause: {clause!r}")
+                key, op, raw = m.group(1), m.group(2), m.group(3)
+                if op == "EXISTS":
+                    self.conditions.append(Condition(key, op))
+                    continue
+                if raw is None:
+                    raise ValueError(f"missing value in clause: {clause!r}")
+                if raw[0] in "'\"":
+                    raw = raw[1:-1]
+                self.conditions.append(Condition(key, op, raw))
+
+    def matches(self, events: Dict[str, List[str]]) -> bool:
+        for cond in self.conditions:
+            values = events.get(cond.key)
+            if values is None:
+                return False
+            if cond.op == "EXISTS":
+                continue
+            if cond.op == "=":
+                if cond.value not in values:
+                    return False
+            elif cond.op == "CONTAINS":
+                if not any(cond.value in v for v in values):
+                    return False
+            else:
+                ok = False
+                for v in values:
+                    try:
+                        fv, cv = float(v), float(cond.value)
+                    except ValueError:
+                        continue
+                    if (
+                        (cond.op == "<" and fv < cv)
+                        or (cond.op == "<=" and fv <= cv)
+                        or (cond.op == ">" and fv > cv)
+                        or (cond.op == ">=" and fv >= cv)
+                    ):
+                        ok = True
+                        break
+                if not ok:
+                    return False
+        return True
+
+    def __str__(self) -> str:
+        return self.query_str
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Query) and self.query_str == other.query_str
+
+    def __hash__(self) -> int:
+        return hash(self.query_str)
+
+
+@dataclass
+class Message:
+    data: object
+    events: Dict[str, List[str]]
+
+
+class Subscription:
+    def __init__(self, out_capacity: int = 100):
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=out_capacity)
+        self.cancelled = False
+        self.cancel_reason = ""
+
+    async def next(self) -> Message:
+        msg = await self.queue.get()
+        if msg is None:
+            raise RuntimeError(f"subscription cancelled: {self.cancel_reason}")
+        return msg
+
+
+class PubSubServer:
+    """In-process server. publish() is non-blocking: a subscriber whose buffer
+    is full is cancelled (same policy as the reference's non-buffered
+    subscriptions)."""
+
+    def __init__(self):
+        self._subs: Dict[Tuple[str, str], Tuple[Query, Subscription]] = {}
+
+    def subscribe(self, subscriber: str, query: Query, out_capacity: int = 100) -> Subscription:
+        key = (subscriber, query.query_str)
+        if key in self._subs:
+            raise ValueError("already subscribed")
+        sub = Subscription(out_capacity)
+        self._subs[key] = (query, sub)
+        return sub
+
+    def unsubscribe(self, subscriber: str, query: Query) -> None:
+        key = (subscriber, query.query_str)
+        entry = self._subs.pop(key, None)
+        if entry is None:
+            raise ValueError("subscription not found")
+        _, sub = entry
+        sub.cancelled = True
+        sub.cancel_reason = "unsubscribed"
+        try:
+            sub.queue.put_nowait(None)
+        except asyncio.QueueFull:
+            pass
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        for key in [k for k in self._subs if k[0] == subscriber]:
+            _, sub = self._subs.pop(key)
+            sub.cancelled = True
+            sub.cancel_reason = "unsubscribed"
+            try:
+                sub.queue.put_nowait(None)
+            except asyncio.QueueFull:
+                pass
+
+    def publish(self, data: object, events: Dict[str, List[str]]) -> None:
+        for key in list(self._subs.keys()):
+            query, sub = self._subs[key]
+            if not query.matches(events):
+                continue
+            try:
+                sub.queue.put_nowait(Message(data, events))
+            except asyncio.QueueFull:
+                # Slow subscriber: cancel it (reference: pubsub.go send on full)
+                sub.cancelled = True
+                sub.cancel_reason = "client is not pulling messages fast enough"
+                del self._subs[key]
+
+    def num_clients(self) -> int:
+        return len({k[0] for k in self._subs})
+
+    def num_client_subscriptions(self, subscriber: str) -> int:
+        return sum(1 for k in self._subs if k[0] == subscriber)

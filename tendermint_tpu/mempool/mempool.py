@@ -1,0 +1,190 @@
+"""Mempool (reference: mempool/clist_mempool.go:36).
+
+Ordered tx pool: CheckTx against the app's mempool connection, LRU dedup
+cache, ReapMaxBytesMaxGas for proposals, post-commit Update with recheck.
+Python's dict preserves insertion order, giving the concurrent-list semantics
+the reference builds from clist; asyncio confines mutation to the event loop
+plus the executor's explicit lock."""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import ABCIClient
+from tendermint_tpu.crypto import tmhash
+
+
+class MempoolError(Exception):
+    pass
+
+
+class TxInCacheError(MempoolError):
+    def __init__(self):
+        super().__init__("tx already exists in cache")
+
+
+@dataclass
+class MempoolTx:
+    tx: bytes
+    height: int  # height when validated
+    gas_wanted: int
+
+
+class Mempool:
+    """(reference: mempool/mempool.go:15 interface + clist_mempool impl)"""
+
+    def __init__(
+        self,
+        proxy_app: ABCIClient,
+        max_txs: int = 5000,
+        max_txs_bytes: int = 1024 * 1024 * 1024,
+        cache_size: int = 10000,
+        keep_invalid_txs_in_cache: bool = False,
+        recheck: bool = True,
+    ):
+        self.proxy_app = proxy_app
+        self.max_txs = max_txs
+        self.max_txs_bytes = max_txs_bytes
+        self.recheck = recheck
+        self.keep_invalid_txs_in_cache = keep_invalid_txs_in_cache
+        self._txs: "OrderedDict[bytes, MempoolTx]" = OrderedDict()  # key: tx hash
+        self._cache: "OrderedDict[bytes, None]" = OrderedDict()
+        self._cache_size = cache_size
+        self._total_bytes = 0
+        self._height = 0
+        self._lock = threading.RLock()
+        self._txs_available_cb: Optional[Callable[[], None]] = None
+        self._notified_txs_available = False
+
+    # -- locking around commit (reference: Lock/Unlock in Mempool iface) ----
+
+    def lock(self) -> None:
+        self._lock.acquire()
+
+    def unlock(self) -> None:
+        self._lock.release()
+
+    # -- size ---------------------------------------------------------------
+
+    def size(self) -> int:
+        return len(self._txs)
+
+    def txs_bytes(self) -> int:
+        return self._total_bytes
+
+    def is_full(self, tx_len: int) -> bool:
+        return len(self._txs) >= self.max_txs or self._total_bytes + tx_len > self.max_txs_bytes
+
+    def flush(self) -> None:
+        with self._lock:
+            self._txs.clear()
+            self._cache.clear()
+            self._total_bytes = 0
+
+    # -- notifications ------------------------------------------------------
+
+    def set_txs_available_callback(self, cb: Callable[[], None]) -> None:
+        self._txs_available_cb = cb
+
+    def _notify_txs_available(self) -> None:
+        if self._txs_available_cb and not self._notified_txs_available and self._txs:
+            self._notified_txs_available = True
+            self._txs_available_cb()
+
+    # -- CheckTx ingress ----------------------------------------------------
+
+    def _cache_push(self, key: bytes) -> bool:
+        if key in self._cache:
+            return False
+        self._cache[key] = None
+        if len(self._cache) > self._cache_size:
+            self._cache.popitem(last=False)
+        return True
+
+    def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
+        """(reference: mempool/clist_mempool.go:234 CheckTx + resCbFirstTime :404)"""
+        with self._lock:
+            if self.is_full(len(tx)):
+                raise MempoolError("mempool is full")
+            key = tmhash.sum256(tx)
+            if not self._cache_push(key):
+                raise TxInCacheError()
+            res = self.proxy_app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+            if res.code == abci.CODE_TYPE_OK:
+                if key not in self._txs:
+                    self._txs[key] = MempoolTx(tx=tx, height=self._height, gas_wanted=res.gas_wanted)
+                    self._total_bytes += len(tx)
+                    self._notify_txs_available()
+            else:
+                if not self.keep_invalid_txs_in_cache:
+                    self._cache.pop(key, None)
+            return res
+
+    # -- proposals ----------------------------------------------------------
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        """(reference: mempool/clist_mempool.go:519)"""
+        with self._lock:
+            out: List[bytes] = []
+            total_bytes = 0
+            total_gas = 0
+            for mtx in self._txs.values():
+                # amino/proto overhead per tx in a block: length prefix
+                overhead = len(mtx.tx) + 8
+                if max_bytes > -1 and total_bytes + overhead > max_bytes:
+                    break
+                if max_gas > -1 and total_gas + mtx.gas_wanted > max_gas:
+                    break
+                total_bytes += overhead
+                total_gas += mtx.gas_wanted
+                out.append(mtx.tx)
+            return out
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        with self._lock:
+            txs = [m.tx for m in self._txs.values()]
+            return txs if n < 0 else txs[:n]
+
+    # -- post-commit update -------------------------------------------------
+
+    def update(
+        self,
+        height: int,
+        txs: List[bytes],
+        deliver_tx_responses: List[abci.ResponseDeliverTx],
+    ) -> None:
+        """Remove committed txs, re-check the remainder
+        (reference: mempool/clist_mempool.go:570 Update + recheckTxs :632).
+        Caller must hold the mempool lock."""
+        self._height = height
+        self._notified_txs_available = False
+        for tx, res in zip(txs, deliver_tx_responses):
+            key = tmhash.sum256(tx)
+            if res.code == abci.CODE_TYPE_OK:
+                self._cache_push(key)  # committed: keep in cache to block replays
+            else:
+                if not self.keep_invalid_txs_in_cache:
+                    self._cache.pop(key, None)
+            old = self._txs.pop(key, None)
+            if old is not None:
+                self._total_bytes -= len(old.tx)
+        if self.recheck and self._txs:
+            self._recheck_txs()
+        if self._txs:
+            self._notify_txs_available()
+
+    def _recheck_txs(self) -> None:
+        for key in list(self._txs.keys()):
+            mtx = self._txs[key]
+            res = self.proxy_app.check_tx(
+                abci.RequestCheckTx(tx=mtx.tx, type=abci.CHECK_TX_TYPE_RECHECK)
+            )
+            if res.code != abci.CODE_TYPE_OK:
+                del self._txs[key]
+                self._total_bytes -= len(mtx.tx)
+                if not self.keep_invalid_txs_in_cache:
+                    self._cache.pop(tmhash.sum256(mtx.tx), None)

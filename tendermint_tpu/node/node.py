@@ -1,0 +1,174 @@
+"""Node assembly (reference: node/node.go:613 NewNode, :840 OnStart).
+
+Wires: DBs → state → proxy app (4 conns) → handshake/replay → event bus +
+indexer → mempool → evidence pool → block executor → consensus → RPC.
+P2P wiring is added by the switch/reactor layer when peers are configured."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Optional
+
+from tendermint_tpu.abci.client import ABCIClient
+from tendermint_tpu.abci.kvstore import (
+    CounterApplication,
+    KVStoreApplication,
+    PersistentKVStoreApplication,
+)
+from tendermint_tpu.config.config import Config
+from tendermint_tpu.consensus.cs_state import ConsensusState
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.wal import WAL
+from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.libs.kvdb import KVDB, MemDB, SQLiteDB
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.proxy.multi import AppConns, local_client_creator
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.sm_state import State, state_from_genesis
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.state.txindex import IndexerService, KVTxIndexer
+from tendermint_tpu.store.blockstore import BlockStore
+from tendermint_tpu.types.event_bus import EventBus
+from tendermint_tpu.types.genesis import GenesisDoc
+
+logger = logging.getLogger("tendermint_tpu.node")
+
+
+def _open_db(cfg: Config, name: str) -> KVDB:
+    if cfg.base.db_backend == "memdb" or not cfg.root_dir:
+        return MemDB()
+    return SQLiteDB(os.path.join(cfg.root_dir, "data", f"{name}.db"))
+
+
+def default_app(name: str):
+    if name == "kvstore":
+        return KVStoreApplication()
+    if name == "persistent_kvstore":
+        return PersistentKVStoreApplication()
+    if name == "counter":
+        return CounterApplication()
+    raise ValueError(f"unknown in-proc app {name!r}")
+
+
+class Node:
+    def __init__(
+        self,
+        config: Config,
+        genesis: GenesisDoc,
+        priv_validator: Optional[FilePV] = None,
+        app=None,
+        client_creator=None,
+    ):
+        self.config = config
+        self.genesis = genesis
+        self.priv_validator = priv_validator
+
+        # databases
+        self.block_db = _open_db(config, "blockstore")
+        self.state_db = _open_db(config, "state")
+        self.evidence_db = _open_db(config, "evidence")
+        self.block_store = BlockStore(self.block_db)
+        self.state_store = StateStore(self.state_db)
+
+        # state from store or genesis
+        state = self.state_store.load()
+        if state is None:
+            genesis.validate_and_complete()
+            state = state_from_genesis(genesis)
+
+        # ABCI app (4 logical connections)
+        if client_creator is None:
+            app = app or default_app(config.base.abci)
+            client_creator = local_client_creator(app)
+        self.app = app
+        self.proxy_app = AppConns(client_creator)
+
+        # event bus + tx indexer
+        self.event_bus = EventBus()
+        self.tx_indexer = KVTxIndexer(_open_db(config, "tx_index"))
+        self.indexer_service = IndexerService(self.tx_indexer, self.event_bus)
+
+        # handshake: sync app with chain
+        handshaker = Handshaker(self.state_store, state, self.block_store, genesis, self.event_bus)
+        state = handshaker.handshake(self.proxy_app)
+        self.state = state
+
+        # mempool
+        self.mempool = Mempool(
+            self.proxy_app.mempool,
+            max_txs=config.mempool.size,
+            max_txs_bytes=config.mempool.max_txs_bytes,
+            cache_size=config.mempool.cache_size,
+            keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
+            recheck=config.mempool.recheck,
+        )
+
+        # evidence pool
+        self.evidence_pool = EvidencePool(self.evidence_db, self.state_store, self.block_store)
+        self.evidence_pool.set_state(state)
+
+        # block executor
+        self.block_exec = BlockExecutor(
+            self.state_store,
+            self.proxy_app.consensus,
+            self.mempool,
+            self.evidence_pool,
+            event_bus=self.event_bus,
+            block_store=self.block_store,
+        )
+
+        # consensus
+        wal_path = (
+            os.path.join(config.root_dir, config.consensus.wal_path)
+            if config.root_dir
+            else os.path.join(os.getcwd(), ".tmp_wal", "wal")
+        )
+        self.wal = WAL(wal_path)
+        self.consensus = ConsensusState(
+            config.consensus,
+            state,
+            self.block_exec,
+            self.block_store,
+            self.mempool,
+            self.evidence_pool,
+            self.wal,
+            event_bus=self.event_bus,
+            priv_validator=priv_validator,
+        )
+
+        self.rpc_server = None
+        self._running = False
+
+    async def start(self) -> None:
+        self._running = True
+        await self.indexer_service.start()
+        await self.consensus.start()
+        if self.config.rpc.laddr:
+            from tendermint_tpu.rpc.server import RPCServer
+
+            self.rpc_server = RPCServer(self)
+            await self.rpc_server.start()
+        logger.info("node started (chain %s)", self.genesis.chain_id)
+
+    async def stop(self) -> None:
+        self._running = False
+        if self.rpc_server is not None:
+            await self.rpc_server.stop()
+        await self.consensus.stop()
+        await self.indexer_service.stop()
+        self.proxy_app.stop()
+        for db in (self.block_db, self.state_db, self.evidence_db):
+            db.close()
+
+    # convenience for tests / RPC
+    async def wait_for_height(self, height: int, timeout: float = 30.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self.block_store.height < height:
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for height {height} (at {self.block_store.height})"
+                )
+            await asyncio.sleep(0.02)

@@ -1,0 +1,39 @@
+"""Multi-connection ABCI proxy (reference: proxy/multi_app_conn.go:21,
+proxy/app_conn.go:13-56).
+
+One ClientCreator yields four independent clients — Consensus, Mempool, Query,
+Snapshot — so block execution, CheckTx, RPC queries, and state-sync snapshots
+proceed concurrently without blocking one another. For the local client they
+share one app lock (same as the reference's local mode)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import ABCIClient, LocalClient
+
+ClientCreator = Callable[[], ABCIClient]
+
+
+def local_client_creator(app: abci.Application) -> ClientCreator:
+    lock = threading.RLock()
+
+    def create() -> ABCIClient:
+        return LocalClient(app, lock)
+
+    return create
+
+
+class AppConns:
+    def __init__(self, creator: ClientCreator):
+        self._creator = creator
+        self.consensus: ABCIClient = creator()
+        self.mempool: ABCIClient = creator()
+        self.query: ABCIClient = creator()
+        self.snapshot: ABCIClient = creator()
+
+    def stop(self) -> None:
+        for c in (self.consensus, self.mempool, self.query, self.snapshot):
+            c.close()

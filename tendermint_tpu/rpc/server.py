@@ -1,0 +1,449 @@
+"""JSON-RPC/HTTP/WebSocket server (reference: rpc/jsonrpc/server + rpc/core/routes.go:10-47).
+
+Serves POST JSON-RPC, GET URI style, and /websocket subscriptions against the
+node's internals (the reference's rpc/core Environment role)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, Optional
+
+from aiohttp import web, WSMsgType
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.libs.pubsub import Query
+from tendermint_tpu.types.event_bus import EVENT_TX, TX_HASH_KEY, query_for_event
+
+logger = logging.getLogger("tendermint_tpu.rpc")
+
+
+def _b64(b: bytes) -> str:
+    import base64
+
+    return base64.b64encode(b).decode()
+
+
+def _result(id_, result) -> dict:
+    return {"jsonrpc": "2.0", "id": id_, "result": result}
+
+
+def _error(id_, code, message, data="") -> dict:
+    return {"jsonrpc": "2.0", "id": id_, "error": {"code": code, "message": message, "data": data}}
+
+
+class RPCServer:
+    def __init__(self, node):
+        self.node = node
+        addr = node.config.rpc.laddr.replace("tcp://", "")
+        host, _, port = addr.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.app = web.Application(client_max_size=node.config.rpc.max_body_bytes)
+        self.app.router.add_post("/", self._handle_jsonrpc)
+        self.app.router.add_get("/websocket", self._handle_websocket)
+        self.app.router.add_get("/{method}", self._handle_uri)
+        self.runner: Optional[web.AppRunner] = None
+        self._routes = {
+            "health": self._health,
+            "status": self._status,
+            "broadcast_tx_async": self._broadcast_tx_async,
+            "broadcast_tx_sync": self._broadcast_tx_sync,
+            "broadcast_tx_commit": self._broadcast_tx_commit,
+            "abci_query": self._abci_query,
+            "abci_info": self._abci_info,
+            "block": self._block,
+            "blockchain": self._blockchain,
+            "commit": self._commit,
+            "validators": self._validators,
+            "genesis": self._genesis,
+            "tx": self._tx,
+            "unconfirmed_txs": self._unconfirmed_txs,
+            "num_unconfirmed_txs": self._num_unconfirmed_txs,
+            "consensus_state": self._consensus_state,
+            "net_info": self._net_info,
+        }
+
+    async def start(self) -> None:
+        self.runner = web.AppRunner(self.app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, self.host, self.port)
+        await site.start()
+        logger.info("RPC server listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self.runner:
+            await self.runner.cleanup()
+
+    # -- transport ----------------------------------------------------------
+
+    async def _handle_jsonrpc(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(_error(None, -32700, "parse error"))
+        id_ = body.get("id")
+        method = body.get("method", "")
+        params = body.get("params", {}) or {}
+        handler = self._routes.get(method)
+        if handler is None:
+            return web.json_response(_error(id_, -32601, f"method {method} not found"))
+        try:
+            result = await handler(params)
+            return web.json_response(_result(id_, result))
+        except Exception as e:
+            logger.exception("rpc error in %s", method)
+            return web.json_response(_error(id_, -32603, "internal error", str(e)))
+
+    async def _handle_uri(self, request: web.Request) -> web.Response:
+        method = request.match_info["method"]
+        handler = self._routes.get(method)
+        if handler is None:
+            return web.json_response(_error(None, -32601, f"method {method} not found"))
+        params = {k: v.strip('"') for k, v in request.query.items()}
+        try:
+            result = await handler(params)
+            return web.json_response(_result(None, result))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
+
+    async def _handle_websocket(self, request: web.Request):
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        subscriber = f"ws-{id(ws)}"
+        tasks = []
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    continue
+                try:
+                    body = json.loads(msg.data)
+                except json.JSONDecodeError:
+                    await ws.send_json(_error(None, -32700, "parse error"))
+                    continue
+                id_ = body.get("id")
+                method = body.get("method", "")
+                params = body.get("params", {}) or {}
+                if method == "subscribe":
+                    try:
+                        q = Query(params.get("query", ""))
+                        sub = self.node.event_bus.subscribe(subscriber, q)
+                    except Exception as e:
+                        await ws.send_json(_error(id_, -32603, "subscribe failed", str(e)))
+                        continue
+                    await ws.send_json(_result(id_, {}))
+
+                    async def pump(sub=sub, q=q, id_=id_):
+                        try:
+                            while True:
+                                m = await sub.next()
+                                await ws.send_json(
+                                    _result(
+                                        id_,
+                                        {
+                                            "query": str(q),
+                                            "data": {"type": m.events.get("tm.event", [""])[0]},
+                                            "events": m.events,
+                                        },
+                                    )
+                                )
+                        except Exception:
+                            pass
+
+                    tasks.append(asyncio.create_task(pump()))
+                elif method == "unsubscribe_all":
+                    self.node.event_bus.unsubscribe_all(subscriber)
+                    await ws.send_json(_result(id_, {}))
+                else:
+                    handler = self._routes.get(method)
+                    if handler is None:
+                        await ws.send_json(_error(id_, -32601, f"method {method} not found"))
+                    else:
+                        try:
+                            await ws.send_json(_result(id_, await handler(params)))
+                        except Exception as e:
+                            await ws.send_json(_error(id_, -32603, "internal error", str(e)))
+        finally:
+            for t in tasks:
+                t.cancel()
+            try:
+                self.node.event_bus.unsubscribe_all(subscriber)
+            except Exception:
+                pass
+        return ws
+
+    # -- handlers (reference: rpc/core/*.go) --------------------------------
+
+    async def _health(self, params) -> dict:
+        return {}
+
+    async def _status(self, params) -> dict:
+        node = self.node
+        latest_height = node.block_store.height
+        latest_block = node.block_store.load_block(latest_height) if latest_height else None
+        pub = node.priv_validator.get_pub_key() if node.priv_validator else None
+        return {
+            "node_info": {
+                "network": node.genesis.chain_id,
+                "moniker": node.config.base.moniker,
+                "version": "0.1.0",
+            },
+            "sync_info": {
+                "latest_block_height": str(latest_height),
+                "latest_block_hash": latest_block.hash().hex().upper() if latest_block else "",
+                "latest_app_hash": node.state.app_hash.hex().upper() if node.state else "",
+                "catching_up": False,
+            },
+            "validator_info": {
+                "address": pub.address().hex().upper() if pub else "",
+                "pub_key": {"type": pub.type_name(), "value": _b64(pub.bytes())} if pub else None,
+                "voting_power": "0",
+            },
+        }
+
+    def _decode_tx_param(self, params) -> bytes:
+        import base64
+
+        tx = params.get("tx", "")
+        if isinstance(tx, str):
+            if tx.startswith("0x"):
+                return bytes.fromhex(tx[2:])
+            try:
+                return base64.b64decode(tx)
+            except Exception:
+                return tx.encode()
+        return bytes(tx)
+
+    async def _broadcast_tx_async(self, params) -> dict:
+        tx = self._decode_tx_param(params)
+        asyncio.get_event_loop().run_in_executor(None, self.node.mempool.check_tx, tx)
+        return {"code": 0, "data": "", "log": "", "hash": tmhash.sum256(tx).hex().upper()}
+
+    async def _broadcast_tx_sync(self, params) -> dict:
+        tx = self._decode_tx_param(params)
+        res = await asyncio.get_event_loop().run_in_executor(None, self.node.mempool.check_tx, tx)
+        return {
+            "code": res.code,
+            "data": _b64(res.data),
+            "log": res.log,
+            "hash": tmhash.sum256(tx).hex().upper(),
+        }
+
+    async def _broadcast_tx_commit(self, params) -> dict:
+        """CheckTx → wait for DeliverTx event (reference: rpc/core/mempool.go)."""
+        tx = self._decode_tx_param(params)
+        tx_hash = tmhash.sum256(tx)
+        q = Query(f"{TX_HASH_KEY} = '{tx_hash.hex().upper()}'")
+        subscriber = f"btc-{tx_hash.hex()[:16]}"
+        sub = self.node.event_bus.subscribe(subscriber, q)
+        try:
+            check = await asyncio.get_event_loop().run_in_executor(
+                None, self.node.mempool.check_tx, tx
+            )
+            if check.code != abci.CODE_TYPE_OK:
+                return {
+                    "check_tx": {"code": check.code, "log": check.log},
+                    "deliver_tx": {},
+                    "hash": tx_hash.hex().upper(),
+                    "height": "0",
+                }
+            timeout = self.node.config.rpc.timeout_broadcast_tx_commit
+            msg = await asyncio.wait_for(sub.next(), timeout=timeout)
+            data = msg.data
+            return {
+                "check_tx": {"code": check.code, "log": check.log},
+                "deliver_tx": {
+                    "code": data.result.code,
+                    "data": _b64(data.result.data),
+                    "log": data.result.log,
+                },
+                "hash": tx_hash.hex().upper(),
+                "height": str(data.height),
+            }
+        finally:
+            try:
+                self.node.event_bus.unsubscribe_all(subscriber)
+            except Exception:
+                pass
+
+    async def _abci_query(self, params) -> dict:
+        data = params.get("data", "")
+        if isinstance(data, str):
+            data = bytes.fromhex(data[2:] if data.startswith("0x") else data)
+        res = self.node.proxy_app.query.query(
+            abci.RequestQuery(
+                data=data,
+                path=params.get("path", ""),
+                height=int(params.get("height", 0)),
+                prove=bool(params.get("prove", False)),
+            )
+        )
+        return {
+            "response": {
+                "code": res.code,
+                "log": res.log,
+                "key": _b64(res.key),
+                "value": _b64(res.value),
+                "height": str(res.height),
+            }
+        }
+
+    async def _abci_info(self, params) -> dict:
+        res = self.node.proxy_app.query.info(abci.RequestInfo())
+        return {
+            "response": {
+                "data": res.data,
+                "version": res.version,
+                "app_version": str(res.app_version),
+                "last_block_height": str(res.last_block_height),
+                "last_block_app_hash": _b64(res.last_block_app_hash),
+            }
+        }
+
+    def _block_to_json(self, block, block_id) -> dict:
+        return {
+            "block_id": {
+                "hash": block_id.hash.hex().upper(),
+                "parts": {
+                    "total": block_id.part_set_header.total,
+                    "hash": block_id.part_set_header.hash.hex().upper(),
+                },
+            },
+            "block": {
+                "header": {
+                    "chain_id": block.header.chain_id,
+                    "height": str(block.header.height),
+                    "time_ns": str(block.header.time_ns),
+                    "last_block_id": {"hash": block.header.last_block_id.hash.hex().upper()},
+                    "app_hash": block.header.app_hash.hex().upper(),
+                    "data_hash": block.header.data_hash.hex().upper(),
+                    "validators_hash": block.header.validators_hash.hex().upper(),
+                    "proposer_address": block.header.proposer_address.hex().upper(),
+                },
+                "data": {"txs": [_b64(tx) for tx in block.txs]},
+                "last_commit": {
+                    "height": str(block.last_commit.height),
+                    "round": block.last_commit.round,
+                    "signatures": len(block.last_commit.signatures),
+                },
+            },
+        }
+
+    async def _block(self, params) -> dict:
+        height = int(params.get("height") or self.node.block_store.height)
+        block = self.node.block_store.load_block(height)
+        if block is None:
+            raise ValueError(f"block at height {height} not found")
+        meta = self.node.block_store.load_block_meta(height)
+        return self._block_to_json(block, meta[0])
+
+    async def _blockchain(self, params) -> dict:
+        store = self.node.block_store
+        max_h = int(params.get("maxHeight") or store.height)
+        min_h = int(params.get("minHeight") or max(store.base, max_h - 19))
+        metas = []
+        for h in range(max_h, min_h - 1, -1):
+            meta = store.load_block_meta(h)
+            if meta is None:
+                continue
+            block = store.load_block(h)
+            metas.append(
+                {
+                    "block_id": {"hash": meta[0].hash.hex().upper()},
+                    "header": {"height": str(h), "chain_id": block.header.chain_id},
+                    "num_txs": str(len(block.txs)),
+                }
+            )
+        return {"last_height": str(store.height), "block_metas": metas}
+
+    async def _commit(self, params) -> dict:
+        height = int(params.get("height") or self.node.block_store.height)
+        commit = self.node.block_store.load_seen_commit(height)
+        block = self.node.block_store.load_block(height)
+        if commit is None or block is None:
+            raise ValueError(f"commit at height {height} not found")
+        return {
+            "signed_header": {
+                "header": {"height": str(height), "chain_id": block.header.chain_id,
+                           "app_hash": block.header.app_hash.hex().upper()},
+                "commit": {
+                    "height": str(commit.height),
+                    "round": commit.round,
+                    "block_id": {"hash": commit.block_id.hash.hex().upper()},
+                    "signatures": [
+                        {
+                            "block_id_flag": int(cs.block_id_flag),
+                            "validator_address": cs.validator_address.hex().upper(),
+                            "signature": _b64(cs.signature),
+                        }
+                        for cs in commit.signatures
+                    ],
+                },
+            },
+            "canonical": True,
+        }
+
+    async def _validators(self, params) -> dict:
+        height = int(params.get("height") or (self.node.state.last_block_height + 1))
+        vals = self.node.state_store.load_validators(height)
+        if vals is None:
+            raise ValueError(f"no validator set at height {height}")
+        return {
+            "block_height": str(height),
+            "validators": [
+                {
+                    "address": v.address.hex().upper(),
+                    "pub_key": {"type": v.pub_key.type_name(), "value": _b64(v.pub_key.bytes())},
+                    "voting_power": str(v.voting_power),
+                    "proposer_priority": str(v.proposer_priority),
+                }
+                for v in vals.validators
+            ],
+            "count": str(len(vals.validators)),
+            "total": str(len(vals.validators)),
+        }
+
+    async def _genesis(self, params) -> dict:
+        return {"genesis": json.loads(self.node.genesis.to_json())}
+
+    async def _tx(self, params) -> dict:
+        h = params.get("hash", "")
+        if isinstance(h, str):
+            tx_hash = bytes.fromhex(h[2:] if h.startswith("0x") else h)
+        else:
+            tx_hash = bytes(h)
+        res = self.node.tx_indexer.get(tx_hash)
+        if res is None:
+            raise ValueError(f"tx {tx_hash.hex()} not found")
+        return {
+            "hash": tx_hash.hex().upper(),
+            "height": str(res.height),
+            "index": res.index,
+            "tx_result": {"code": res.code, "data": _b64(res.data), "log": res.log},
+            "tx": _b64(res.tx),
+        }
+
+    async def _unconfirmed_txs(self, params) -> dict:
+        limit = int(params.get("limit", 30))
+        txs = self.node.mempool.reap_max_txs(limit)
+        return {
+            "n_txs": str(len(txs)),
+            "total": str(self.node.mempool.size()),
+            "total_bytes": str(self.node.mempool.txs_bytes()),
+            "txs": [_b64(tx) for tx in txs],
+        }
+
+    async def _num_unconfirmed_txs(self, params) -> dict:
+        return {
+            "n_txs": str(self.node.mempool.size()),
+            "total": str(self.node.mempool.size()),
+            "total_bytes": str(self.node.mempool.txs_bytes()),
+        }
+
+    async def _consensus_state(self, params) -> dict:
+        return {"round_state": self.node.consensus.rs.round_state_summary()}
+
+    async def _net_info(self, params) -> dict:
+        return {"listening": False, "listeners": [], "n_peers": "0", "peers": []}

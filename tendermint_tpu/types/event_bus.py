@@ -1,0 +1,124 @@
+"""EventBus — typed wrapper over pubsub (reference: types/event_bus.go:33).
+
+Composite keys follow the reference convention: `tm.event` for the event type,
+`tx.hash`/`tx.height` for txs, and app-emitted `<event_type>.<attr_key>`."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.libs.pubsub import PubSubServer, Query, Subscription
+
+EVENT_NEW_BLOCK = "NewBlock"
+EVENT_NEW_BLOCK_HEADER = "NewBlockHeader"
+EVENT_NEW_ROUND = "NewRound"
+EVENT_NEW_ROUND_STEP = "NewRoundStep"
+EVENT_COMPLETE_PROPOSAL = "CompleteProposal"
+EVENT_POLKA = "Polka"
+EVENT_LOCK = "Lock"
+EVENT_RELOCK = "Relock"
+EVENT_TIMEOUT_PROPOSE = "TimeoutPropose"
+EVENT_TIMEOUT_WAIT = "TimeoutWait"
+EVENT_VOTE = "Vote"
+EVENT_VALID_BLOCK = "ValidBlock"
+EVENT_VALIDATOR_SET_UPDATES = "ValidatorSetUpdates"
+EVENT_TX = "Tx"
+
+EVENT_TYPE_KEY = "tm.event"
+TX_HASH_KEY = "tx.hash"
+TX_HEIGHT_KEY = "tx.height"
+
+
+def query_for_event(event_type: str) -> Query:
+    return Query(f"{EVENT_TYPE_KEY} = '{event_type}'")
+
+
+@dataclass
+class EventDataTx:
+    height: int
+    index: int
+    tx: bytes
+    result: object  # abci.ResponseDeliverTx
+
+
+@dataclass
+class EventDataNewBlock:
+    block: object
+    block_id: object
+    result_begin_block: object
+    result_end_block: object
+
+
+@dataclass
+class EventDataRoundState:
+    height: int
+    round: int
+    step: str
+
+
+@dataclass
+class EventDataVote:
+    vote: object
+
+
+class EventBus:
+    def __init__(self):
+        self.pubsub = PubSubServer()
+
+    def subscribe(self, subscriber: str, query: Query, out_capacity: int = 100) -> Subscription:
+        return self.pubsub.subscribe(subscriber, query, out_capacity)
+
+    def unsubscribe(self, subscriber: str, query: Query) -> None:
+        self.pubsub.unsubscribe(subscriber, query)
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        self.pubsub.unsubscribe_all(subscriber)
+
+    def _publish(self, event_type: str, data: object, extra: Optional[Dict[str, List[str]]] = None) -> None:
+        events = {EVENT_TYPE_KEY: [event_type]}
+        if extra:
+            for k, v in extra.items():
+                events.setdefault(k, []).extend(v)
+        self.pubsub.publish(data, events)
+
+    @staticmethod
+    def _abci_events_to_map(abci_events) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {}
+        for ev in abci_events or []:
+            for key, value, index in ev.attributes:
+                if not index:
+                    continue
+                k = f"{ev.type}.{key.decode(errors='replace')}"
+                out.setdefault(k, []).append(value.decode(errors="replace"))
+        return out
+
+    def publish_new_block(self, block, block_id, abci_responses) -> None:
+        extra: Dict[str, List[str]] = {}
+        if abci_responses.begin_block is not None:
+            extra.update(self._abci_events_to_map(abci_responses.begin_block.events))
+        if abci_responses.end_block is not None:
+            extra.update(self._abci_events_to_map(abci_responses.end_block.events))
+        self._publish(
+            EVENT_NEW_BLOCK,
+            EventDataNewBlock(block, block_id, abci_responses.begin_block, abci_responses.end_block),
+            extra,
+        )
+
+    def publish_tx(self, height: int, index: int, tx: bytes, result) -> None:
+        extra = {
+            TX_HASH_KEY: [tmhash.sum256(tx).hex().upper()],
+            TX_HEIGHT_KEY: [str(height)],
+        }
+        extra.update(self._abci_events_to_map(getattr(result, "events", None)))
+        self._publish(EVENT_TX, EventDataTx(height, index, tx, result), extra)
+
+    def publish_validator_set_updates(self, updates) -> None:
+        self._publish(EVENT_VALIDATOR_SET_UPDATES, updates)
+
+    def publish_vote(self, vote) -> None:
+        self._publish(EVENT_VOTE, EventDataVote(vote))
+
+    def publish_round_state(self, event_type: str, height: int, round_: int, step: str) -> None:
+        self._publish(event_type, EventDataRoundState(height, round_, step))

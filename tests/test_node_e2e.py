@@ -1,0 +1,142 @@
+"""End-to-end: single-validator node produces blocks against the kvstore app.
+
+This is the 'minimum end-to-end slice' (SURVEY.md §7.6): every commit flows
+through consensus (propose → prevote → precommit → commit) with real
+signatures, the WAL, the block store, and ABCI."""
+
+import asyncio
+import os
+
+import pytest
+
+from tendermint_tpu.abci import types as abci_types
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.config.config import test_config
+from tendermint_tpu.crypto import gen_ed25519
+from tendermint_tpu.node.node import Node
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+# Host-path verification for consensus votes in these tests (1 validator);
+# the batched TPU path is exercised by test_validator_set/test_ed25519_jax.
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+
+def make_node(tmp_path, n_blocks_app=None, root=None):
+    cfg = test_config()
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = ""  # no RPC in this test
+    cfg.root_dir = ""
+    if root:
+        cfg.root_dir = str(root)
+        cfg.base.db_backend = "sqlite"
+    priv = FilePV(gen_ed25519(b"\x42" * 32))
+    gen = GenesisDoc(
+        chain_id="e2e-chain",
+        validators=[GenesisValidator(priv.get_pub_key(), 10)],
+    )
+    app = KVStoreApplication()
+    node = Node(cfg, gen, priv_validator=priv, app=app)
+    # WAL in tmp
+    return node
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def test_single_node_produces_blocks(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+
+    async def run():
+        node = make_node(tmp_path)
+        await node.start()
+        try:
+            await node.wait_for_height(3, timeout=30)
+            assert node.block_store.height >= 3
+            # blocks are linked
+            b2 = node.block_store.load_block(2)
+            b3 = node.block_store.load_block(3)
+            assert b3.header.last_block_id.hash == b2.hash()
+            # commits verify against the validator set
+            commit = node.block_store.load_seen_commit(3)
+            meta = node.block_store.load_block_meta(3)
+            vals = node.state_store.load_validators(3)
+            vals.verify_commit("e2e-chain", meta[0], 3, commit)
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+
+def test_node_commits_txs(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+
+    async def run():
+        node = make_node(tmp_path)
+        await node.start()
+        try:
+            await node.wait_for_height(1, timeout=30)
+            res = node.mempool.check_tx(b"name=satoshi")
+            assert res.code == abci_types.CODE_TYPE_OK
+            # wait until the tx lands in a block
+            deadline = asyncio.get_event_loop().time() + 20
+            committed = None
+            while asyncio.get_event_loop().time() < deadline:
+                for h in range(1, node.block_store.height + 1):
+                    block = node.block_store.load_block(h)
+                    if block and b"name=satoshi" in block.txs:
+                        committed = h
+                        break
+                if committed:
+                    break
+                await asyncio.sleep(0.05)
+            assert committed, "tx never committed"
+            # app state reflects the tx
+            res = node.proxy_app.query.query(
+                abci_types.RequestQuery(data=b"name", path="/store")
+            )
+            assert res.value == b"satoshi"
+            # mempool no longer has it
+            assert node.mempool.size() == 0
+            # tx was indexed
+            from tendermint_tpu.crypto import tmhash
+
+            await asyncio.sleep(0.2)  # indexer is async
+            assert node.tx_indexer.get(tmhash.sum256(b"name=satoshi")) is not None
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+
+def test_node_restart_resumes(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    root = tmp_path / "node_home"
+    (root / "data").mkdir(parents=True)
+
+    async def run1():
+        node = make_node(tmp_path, root=root)
+        await node.start()
+        try:
+            await node.wait_for_height(2, timeout=30)
+            return node.block_store.height
+        finally:
+            await node.stop()
+
+    h1 = asyncio.run(run1())
+    assert h1 >= 2
+
+    async def run2():
+        node = make_node(tmp_path, root=root)
+        # handshake must have synced state with store
+        assert node.state.last_block_height == node.block_store.height
+        assert node.block_store.height >= h1
+        await node.start()
+        try:
+            await node.wait_for_height(h1 + 2, timeout=30)
+        finally:
+            await node.stop()
+
+    asyncio.run(run2())
