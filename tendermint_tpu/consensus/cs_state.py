@@ -134,7 +134,29 @@ class ConsensusState:
                 lambda: loop.call_soon_threadsafe(self._enqueue_nowait, ("txs_available", None))
             )
         self._loop_task = asyncio.create_task(self._receive_loop(), name="cs-receive")
-        self._schedule_round0()
+        if self.rs.step == RoundStepType.NEW_HEIGHT:
+            self._schedule_round0()
+        elif self.rs.step == RoundStepType.COMMIT:
+            # Replay re-entered COMMIT. If the block is already complete this
+            # finalizes immediately (we are the only mutator until the loop
+            # drains); if parts are missing only peer gossip can supply them —
+            # no timeout applies (reference: enterCommit waits on gossip).
+            self._try_finalize_commit(self.rs.height)
+            if self.rs.step == RoundStepType.NEW_HEIGHT:
+                self._schedule_round0()
+        else:
+            # WAL catchup left us mid-height. A NEW_HEIGHT timeout would be
+            # dropped by _handle_timeout's step guard, and any timer left over
+            # from replay may target an already-passed step — either way the
+            # node would stall with no timer. Re-drive liveness by arming the
+            # round's precommit-wait timeout: when it fires we precommit
+            # (honoring locks) and advance to the next round, where peers/our
+            # own proposer turn make progress (reference: consensus/replay.go:93
+            # relies on gossip to re-drive; a single-node net has no gossip).
+            self._schedule_timeout(
+                self.config.precommit_timeout(self.rs.round),
+                self.rs.height, self.rs.round, RoundStepType.PRECOMMIT_WAIT,
+            )
 
     async def stop(self) -> None:
         self._running = False
@@ -204,15 +226,25 @@ class ConsensusState:
             self._stopped.set()
 
     def _handle_msg(self, mi: MsgInfo) -> None:
+        """Per-message errors are logged and tolerated — only genuine invariant
+        violations (anything that escapes this method) halt consensus
+        (reference: consensus/state.go:766 handleMsg logs errors and continues).
+        """
         msg, peer_id = mi.msg, mi.peer_id
-        if isinstance(msg, ProposalMessage):
-            self._set_proposal(msg.proposal)
-        elif isinstance(msg, BlockPartMessage):
-            self._add_proposal_block_part(msg, peer_id)
-        elif isinstance(msg, VoteMessage):
-            self._try_add_vote(msg.vote, peer_id)
-        else:
-            logger.error("unknown msg type %s", type(msg))
+        try:
+            if isinstance(msg, ProposalMessage):
+                msg.proposal.validate_basic()
+                self._set_proposal(msg.proposal)
+            elif isinstance(msg, BlockPartMessage):
+                msg.part.validate_basic()
+                self._add_proposal_block_part(msg, peer_id)
+            elif isinstance(msg, VoteMessage):
+                msg.vote.validate_basic()
+                self._try_add_vote(msg.vote, peer_id)
+            else:
+                logger.error("unknown msg type %s", type(msg))
+        except (VoteSetError, ValueError) as e:
+            logger.error("error with msg %s from %s: %s", type(msg).__name__, peer_id or "self", e)
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         rs = self.rs
@@ -928,7 +960,9 @@ class ConsensusState:
         try:
             for msg in msgs:
                 if isinstance(msg, MsgInfo):
-                    self.wal.write(msg)
+                    # Read-only replay: the messages are already durable in
+                    # the WAL (reference: consensus/replay.go:93 catchupReplay
+                    # only reads; re-writing would grow the WAL every restart).
                     try:
                         self._handle_msg(msg)
                     except Exception as e:

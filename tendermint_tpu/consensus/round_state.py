@@ -92,7 +92,10 @@ class HeightVoteSet:
     def pol_info(self) -> Tuple[int, Optional[BlockID]]:
         """Highest round with a prevote 2/3 majority (reference:
         height_vote_set.go POLInfo)."""
-        for r in sorted(self._round_vote_sets.keys(), reverse=True):
+        # Only rounds <= self.round: a majority recorded in a peer-catchup
+        # round above ours must not be reported as the POL (reference:
+        # height_vote_set.go POLInfo scans hvs.round down to 0).
+        for r in sorted((r for r in self._round_vote_sets if r <= self.round), reverse=True):
             vs = self.prevotes(r)
             if vs is not None:
                 bid = vs.two_thirds_majority()

@@ -136,7 +136,28 @@ def gen_ed25519(seed: bytes | None = None) -> Ed25519PrivKey:
     return Ed25519PrivKey(seed if seed is not None else os.urandom(PRIVKEY_SIZE))
 
 
+_P25519 = 2**255 - 19
+
+
 def pubkey_from_type_and_bytes(type_name: str, data: bytes) -> PubKey:
+    """Validator-ingestion entry point (genesis + ABCI validator updates).
+
+    Rejects non-canonical ed25519 encodings (y >= p): the host backend
+    (OpenSSL) accepts them while the TPU backend rejects them, so admitting
+    such a key would let verification semantics diverge per-node — a fork
+    risk. Enforcing canonicality here makes both backends agree for every key
+    that can ever enter a validator set.
+    """
     if type_name == ED25519_KEY_TYPE:
+        if len(data) == PUBKEY_SIZE and (
+            int.from_bytes(data, "little") & ((1 << 255) - 1)
+        ) >= _P25519:
+            raise ValueError("non-canonical ed25519 pubkey encoding (y >= p)")
         return Ed25519PubKey(data)
+    if type_name == SR25519_KEY_TYPE:
+        try:
+            from tendermint_tpu.crypto.sr25519 import Sr25519PubKey
+        except ImportError as e:  # pragma: no cover
+            raise ValueError(f"sr25519 backend unavailable: {e}") from e
+        return Sr25519PubKey(data)
     raise ValueError(f"unknown pubkey type {type_name!r}")

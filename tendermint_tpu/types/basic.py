@@ -18,6 +18,14 @@ from tendermint_tpu.libs import protowire as pw
 
 NANOS = 1_000_000_000
 
+# Block part size (reference: types/params.go BlockPartSizeBytes) and the hard
+# block-size cap (reference: types/params.go MaxBlockSizeBytes = 100MB); the
+# part-total bound derives from them. Decoded peer values above the bound are
+# rejected before any allocation sized by them (PartSetHeader.validate_basic).
+BLOCK_PART_SIZE_BYTES = 65536
+MAX_BLOCK_SIZE_BYTES = 104_857_600
+MAX_PART_SET_TOTAL = (MAX_BLOCK_SIZE_BYTES // BLOCK_PART_SIZE_BYTES) + 1
+
 
 def ts_seconds_nanos(ts_ns: int) -> tuple[int, int]:
     return divmod(ts_ns, NANOS)
@@ -47,6 +55,8 @@ class PartSetHeader:
     def validate_basic(self) -> None:
         if self.total < 0:
             raise ValueError("negative Total")
+        if self.total > MAX_PART_SET_TOTAL:
+            raise ValueError(f"Total {self.total} exceeds maximum {MAX_PART_SET_TOTAL}")
         if self.hash and len(self.hash) != tmhash.SIZE:
             raise ValueError("wrong Hash size")
 
@@ -89,7 +99,10 @@ class BlockID:
         self.part_set_header.validate_basic()
 
     def key(self) -> bytes:
-        return self.hash + self.part_set_header.hash + self.part_set_header.total.to_bytes(4, "big")
+        # 8-byte width accommodates any varint-decodable total; callers are
+        # expected to validate_basic() first, but key() itself must not raise
+        # on hostile input (it sits on the VoteSet.add_vote path).
+        return self.hash + self.part_set_header.hash + (self.part_set_header.total & (2**64 - 1)).to_bytes(8, "big")
 
     def encode(self) -> bytes:
         w = pw.Writer()

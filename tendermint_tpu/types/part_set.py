@@ -9,9 +9,7 @@ from typing import List, Optional
 
 from tendermint_tpu.crypto import merkle
 from tendermint_tpu.libs import protowire as pw
-from tendermint_tpu.types.basic import PartSetHeader
-
-BLOCK_PART_SIZE_BYTES = 65536
+from tendermint_tpu.types.basic import BLOCK_PART_SIZE_BYTES, PartSetHeader
 
 
 @dataclass(frozen=True)
@@ -68,6 +66,7 @@ class PartSet:
     """Complete (from data) or incomplete (from header, filled by gossip)."""
 
     def __init__(self, header: PartSetHeader):
+        header.validate_basic()  # bounds total before the allocation below
         self._header = header
         self._parts: List[Optional[Part]] = [None] * header.total
         self._count = 0
