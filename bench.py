@@ -1,0 +1,145 @@
+"""Benchmark harness: BASELINE.md configs, CPU-serial vs TPU.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+
+The headline metric is the north star (BASELINE.md): wall latency to verify a
+10k-validator commit on TPU, with vs_baseline = serial-CPU-time / TPU-time
+(the reference's serial loop semantics, types/validator_set.go:680-702).
+
+Sub-benchmarks (in "extra"):
+  batch128            — 128-sig batch verify (BASELINE config 1)
+  verify_commit_1k    — VerifyCommit, 1k validators (config 2)
+  light_trusting_4k   — VerifyCommitLightTrusting, 4k validators (config 3)
+  streaming_10k       — sustained sigs/s over repeated 10k batches (config 5)
+
+Run WITHOUT the test conftest (needs the real TPU): `python bench.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def make_batch(n: int, msg_len: int = 110):
+    """n real signed (pubkey, msg, sig) triples, distinct keys, vote-sized msgs."""
+    from tendermint_tpu.crypto.keys import gen_ed25519
+
+    rng = np.random.default_rng(1234)
+    pubkeys, msgs, sigs = [], [], []
+    for i in range(n):
+        seed = rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+        priv = gen_ed25519(seed)
+        msg = b"%06d|" % i + bytes(rng.integers(0, 256, msg_len - 7, dtype=np.uint8))
+        pubkeys.append(priv.pub_key().bytes())
+        msgs.append(msg)
+        sigs.append(priv.sign(msg))
+    return pubkeys, msgs, sigs
+
+
+def time_cpu_serial(pubkeys, msgs, sigs) -> float:
+    """The reference-shaped baseline: one OpenSSL verify per signature."""
+    from tendermint_tpu.crypto.batch import verify_batch_cpu
+
+    t0 = time.perf_counter()
+    mask = verify_batch_cpu(pubkeys, msgs, sigs)
+    dt = time.perf_counter() - t0
+    assert mask.all()
+    return dt
+
+
+def time_tpu(pubkeys, msgs, sigs, iters: int = 3):
+    """TPU end-to-end (host prep + device) and device-only times, best of iters."""
+    from tendermint_tpu.crypto.batch import prepare_batch
+    from tendermint_tpu.ops.ed25519_jax import verify_prepared
+
+    best_e2e = best_dev = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
+        t1 = time.perf_counter()
+        mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
+        t2 = time.perf_counter()
+        assert (mask & precheck).all()
+        best_e2e = min(best_e2e, t2 - t0)
+        best_dev = min(best_dev, t2 - t1)
+    return best_e2e, best_dev
+
+
+def bench_config(name: str, n: int, serial_n: int | None = None):
+    """One config: serial CPU baseline vs TPU. serial_n: subsample for the CPU
+    loop when n is large (extrapolate linearly — the loop is exactly linear)."""
+    log(f"[{name}] building {n} signed triples...")
+    pubkeys, msgs, sigs = make_batch(n)
+
+    sn = serial_n or n
+    cpu_s = time_cpu_serial(pubkeys[:sn], msgs[:sn], sigs[:sn]) * (n / sn)
+
+    # warm up compile out of band
+    log(f"[{name}] cpu-serial {cpu_s*1e3:.2f} ms; compiling+running TPU path...")
+    e2e, dev = time_tpu(pubkeys, msgs, sigs)
+    log(
+        f"[{name}] tpu e2e {e2e*1e3:.2f} ms (device {dev*1e3:.2f} ms) — "
+        f"{n/e2e:,.0f} sigs/s e2e, speedup {cpu_s/e2e:.1f}x"
+    )
+    return {
+        "n": n,
+        "cpu_serial_ms": round(cpu_s * 1e3, 3),
+        "tpu_e2e_ms": round(e2e * 1e3, 3),
+        "tpu_device_ms": round(dev * 1e3, 3),
+        "sigs_per_sec_e2e": round(n / e2e),
+        "speedup_e2e": round(cpu_s / e2e, 2),
+        "speedup_device": round(cpu_s / dev, 2),
+    }
+
+
+def main():
+    import jax
+
+    log("devices:", jax.devices())
+
+    extra = {}
+    extra["batch128"] = bench_config("batch128", 128)
+    extra["verify_commit_1k"] = bench_config("verify_commit_1k", 1000)
+    extra["light_trusting_4k"] = bench_config("light_trusting_4k", 4096, serial_n=1024)
+    head = bench_config("verify_commit_10k", 10000, serial_n=1024)
+    extra["verify_commit_10k"] = head
+
+    # streaming: sustained throughput over 5 consecutive 10k batches (compile warm)
+    from tendermint_tpu.crypto.batch import prepare_batch
+    from tendermint_tpu.ops.ed25519_jax import verify_prepared
+
+    pubkeys, msgs, sigs = make_batch(10000)
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
+        mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
+        assert (mask & precheck).all()
+    stream = reps * 10000 / (time.perf_counter() - t0)
+    extra["streaming_10k_sigs_per_sec"] = round(stream)
+    log(f"[streaming] {stream:,.0f} sigs/s sustained")
+
+    print(
+        json.dumps(
+            {
+                "metric": "verify_commit_10k_latency",
+                "value": head["tpu_e2e_ms"],
+                "unit": "ms",
+                "vs_baseline": head["speedup_e2e"],
+                "extra": extra,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

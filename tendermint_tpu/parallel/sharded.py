@@ -1,0 +1,129 @@
+"""Multi-chip sharded batch verification (the framework's scale-out axis).
+
+Verification is embarrassingly parallel over the validator axis, so the
+multi-chip design is: shard the trailing batch axis of every input tensor
+across a `jax.sharding.Mesh`, run the single-device kernel per shard via
+`shard_map`, and reduce cross-chip only for the O(1) aggregates (voting-power
+tallies) with `psum` — which XLA lowers onto ICI.
+
+Two mesh shapes are supported:
+- 1D ("vals",): commit verification sharded across validators — replaces the
+  reference's serial loop (reference: types/validator_set.go:680-702) at
+  multi-chip scale.
+- 2D ("blocks", "vals"): fast-sync historical replay sharded across blocks AND
+  validators (reference: blockchain/v0/reactor.go VerifyCommitLight per block)
+  — the batch axes of `verify_prepared` are arbitrary-rank, so a [32, NB, NV]
+  tensor shards across both mesh axes with zero kernel changes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tendermint_tpu.ops.ed25519_jax import verify_prepared
+
+
+def make_mesh(devices=None, shape=None, axis_names=("vals",)) -> Mesh:
+    """Build a device mesh. Default: all devices on one 'vals' axis."""
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    arr = np.asarray(devices)
+    if shape is not None:
+        arr = arr.reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def sharded_verify(mesh: Mesh):
+    """jit'd verify_prepared with the batch axis sharded across the mesh.
+
+    Inputs [32,B]/[253,B] (or [..., NB, NV] for 2D meshes); batch axes map to
+    mesh axes right-aligned: the last input axis onto the last mesh axis, etc.
+    Returns the bool mask with the same sharded layout.
+    """
+    n_batch_axes = len(mesh.axis_names)
+    spec_in = P(None, *mesh.axis_names)
+    spec_out = P(*mesh.axis_names)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec_in, spec_in, spec_in, spec_in),
+        out_specs=spec_out,
+        check_vma=False,
+    )
+    def _verify(a, r, s_bits, h_bits):
+        return verify_prepared(a, r, s_bits, h_bits)
+
+    del n_batch_axes
+    return jax.jit(_verify)
+
+
+def sharded_commit_step(mesh: Mesh):
+    """The full 'training step' analog: batched commit verification.
+
+    Per-shard signature verification + cross-chip psum of the voting power
+    carried by valid signatures; accepts iff valid power > 2/3 of total
+    (reference: types/validator_set.go:662 VerifyCommit tally semantics).
+    Returns (mask, ok) with mask sharded and ok replicated.
+    """
+    spec_in = P(None, *mesh.axis_names)
+    spec_p = P(*mesh.axis_names)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec_in, spec_in, spec_in, spec_in, spec_in),
+        out_specs=(spec_p, P(), P()),
+        check_vma=False,
+    )
+    def _step(a, r, s_bits, h_bits, power_planes):
+        mask = verify_prepared(a, r, s_bits, h_bits)
+        # Exact int64 tallies without x64: powers arrive as four uint32 planes
+        # of 16 bits each (see split_powers). Each plane sum is bounded by
+        # N*2^16, safe in uint32 for N up to 2^15 validators per shard; psum
+        # across the mesh and recombine host-side in Python ints (reference
+        # tally semantics: types/validator_set.go:662 uses int64 power).
+        valid_planes = jnp.where(mask[None], power_planes, 0)
+        talled = jnp.sum(valid_planes, axis=tuple(range(1, valid_planes.ndim)))
+        total = jnp.sum(power_planes, axis=tuple(range(1, power_planes.ndim)))
+        for ax in mesh.axis_names:
+            talled = jax.lax.psum(talled, ax)
+            total = jax.lax.psum(total, ax)
+        return mask, talled, total
+
+    stepped = jax.jit(_step)
+
+    def step(a, r, s_bits, h_bits, power_planes):
+        import numpy as np
+
+        mask, talled, total = stepped(a, r, s_bits, h_bits, power_planes)
+
+        def _join(planes) -> int:
+            return sum(int(v) << (16 * k) for k, v in enumerate(np.asarray(planes)))
+
+        ok = _join(talled) * 3 > _join(total) * 2
+        return mask, ok
+
+    return step
+
+
+def split_powers(powers) -> "jnp.ndarray":
+    """int64-range voting powers -> uint32[4, ...batch] planes of 16 bits
+    each (exact for powers < 2^64; reference powers are int64)."""
+    import numpy as np
+
+    p = np.asarray(powers, dtype=np.uint64)
+    planes = np.stack([(p >> np.uint64(16 * k)) & np.uint64(0xFFFF) for k in range(4)])
+    return planes.astype(np.uint32)
+
+
+def shard_batch_arrays(mesh: Mesh, *arrays):
+    """Device-put host arrays with the trailing axes sharded over the mesh."""
+    spec = P(None, *mesh.axis_names)
+    sharding = NamedSharding(mesh, spec)
+    return tuple(jax.device_put(a, sharding) for a in arrays)
