@@ -60,10 +60,21 @@ def verify_batch_cpu(
     return out
 
 
-def _bits_le(vals: np.ndarray, nbits: int) -> np.ndarray:
-    """uint8[N, 32] little-endian scalars -> uint8[nbits, N] LSB-first bits."""
-    bits = np.unpackbits(vals, axis=1, bitorder="little")  # (N, 256)
-    return np.ascontiguousarray(bits[:, :nbits].T)
+def _signed_radix16(vals: np.ndarray) -> np.ndarray:
+    """uint8[N, 32] little-endian scalars (< 2^253) -> int8[64, N] signed
+    radix-16 digits in [-8, 8], LSB-first. Vectorized over the batch."""
+    n = vals.shape[0]
+    digits = np.empty((n, 64), dtype=np.int16)
+    digits[:, 0::2] = vals & 0x0F
+    digits[:, 1::2] = vals >> 4
+    carry = np.zeros(n, dtype=np.int16)
+    for i in range(64):
+        d = digits[:, i] + carry
+        carry = (d > 8).astype(np.int16)
+        digits[:, i] = d - 16 * carry
+    # scalars < 2^253 => top digit <= 1 before carry, <= 2 after: no overflow
+    assert not carry.any()
+    return np.ascontiguousarray(digits.T.astype(np.int8))
 
 
 def prepare_batch(
@@ -71,7 +82,7 @@ def prepare_batch(
 ):
     """Host-side preprocessing for the device kernel.
 
-    Returns (a_bytes[32,B], r_bytes[32,B], s_bits[253,B], h_bits[253,B],
+    Returns (a_bytes[32,B], r_bytes[32,B], s_digits[64,B], h_digits[64,B],
     precheck[N] bool, n) with B = padded bucket size.
     """
     n = len(pubkeys)
@@ -96,13 +107,11 @@ def prepare_batch(
             int.from_bytes(hashlib.sha512(sig[:32] + pk + msg).digest(), "little") % L
         )
         h[i] = np.frombuffer(h_int.to_bytes(32, "little"), dtype=np.uint8)
-    from tendermint_tpu.ops.ed25519_jax import SCALAR_BITS
-
     return (
         np.ascontiguousarray(a.T),
         np.ascontiguousarray(r.T),
-        _bits_le(s, SCALAR_BITS),
-        _bits_le(h, SCALAR_BITS),
+        _signed_radix16(s),
+        _signed_radix16(h),
         precheck,
         n,
     )

@@ -9,17 +9,22 @@ Semantics: cofactorless verification — accept iff [s]B == R + [h]A exactly,
 computed as enc([s]B + [h](-A)) == enc(R), with s < L enforced host-side —
 the same equation golang.org/x/crypto/ed25519 checks. One (documented)
 divergence: we reject public keys whose y coordinate is non-canonical (>= p),
-which x/crypto accepts; honest keys are never affected.
+which x/crypto accepts; honest keys are never affected (and non-canonical
+keys are refused at validator ingestion, crypto/keys.py).
 
-Layout: batch on the TRAILING axis everywhere (limbs/bytes/bits leading) so
+Layout: batch on the TRAILING axis everywhere (limbs/bytes/digits leading) so
 the batch maps onto TPU vector lanes. Points are (X, Y, Z, T) extended twisted
 Edwards coordinates; adds use the unified a=-1 formulas, so identity and
 doubling need no special cases inside the scan.
 
-The scalar multiplication is a joint (Shamir) double-scalar ladder: 253
-double-and-add steps selecting from {O, B, -A, B-A} per bit pair — one scan
-whose body is ~17 field muls, giving a compact XLA graph independent of batch
-size.
+The scalar multiplication is a joint windowed double-scalar ladder in signed
+radix-16: scalars are recoded host-side into 64 digits in [-8, 8] (LSB-first
+in memory, scanned MSB-first). Each scan step does 4 doublings, one mixed add
+from a CONSTANT basepoint table (j*B in affine niels form, j=0..8, negation by
+coordinate swap) and one unified add from the per-signature table j*(-A)
+(j=0..8 extended points, built with 7 adds + 1 double before the scan). 64
+steps of ~48 field muls replaces the round-1 design's 253 steps of ~17 — ~25%
+fewer field muls and 4x fewer sequential scan iterations.
 """
 
 from __future__ import annotations
@@ -28,11 +33,15 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from tendermint_tpu.crypto import ed25519_ref as _ref
 from tendermint_tpu.crypto.ed25519_ref import BX as _BX, _BY
 from tendermint_tpu.ops import fe25519 as fe
 
-SCALAR_BITS = 253  # covers s, h < L < 2^253
+SCALAR_BITS = 253  # s, h < L < 2^253
+NUM_DIGITS = 64  # signed radix-16 digits covering 256 bits
+WINDOW = 8  # table holds j*P for j in 0..8; sign handled by negation
 
 
 class Point(NamedTuple):
@@ -141,37 +150,111 @@ def compress(p: Point) -> jnp.ndarray:
     return out.at[31].set(out[31] | sign)
 
 
+def _basepoint_niels_table() -> np.ndarray:
+    """Host precompute: j*B for j=0..8 in affine niels form (y+x, y-x, 2dxy),
+    canonical limbs. Shape (9, 3, 20) uint32. Entry 0 is the identity (1,1,0),
+    so digit 0 rides the same unified mixed-add formula."""
+    tab = np.zeros((WINDOW + 1, 3, fe.NLIMBS), dtype=np.uint32)
+    tab[0, 0] = fe.from_int(1)
+    tab[0, 1] = fe.from_int(1)
+    for j in range(1, WINDOW + 1):
+        X, Y, Z, _T = _ref.point_mul(j, _ref.BASE)
+        zinv = pow(Z, fe.P - 2, fe.P)
+        x, y = X * zinv % fe.P, Y * zinv % fe.P
+        tab[j, 0] = fe.from_int((y + x) % fe.P)
+        tab[j, 1] = fe.from_int((y - x) % fe.P)
+        tab[j, 2] = fe.from_int(2 * fe.D * x * y % fe.P)
+    return tab
+
+
+_B_NIELS = jnp.asarray(_basepoint_niels_table())  # (9, 3, 20)
+
+
+def add_niels(p: Point, yplus: jnp.ndarray, yminus: jnp.ndarray, xy2d: jnp.ndarray) -> Point:
+    """Mixed add of an affine niels point (7M): the unified a=-1 formula with
+    Z2=1 and the (y2+x2, y2-x2, 2d*x2*y2) products precomputed."""
+    a = fe.mul(fe.sub(p.y, p.x), yminus)
+    b = fe.mul(fe.add(p.y, p.x), yplus)
+    c = fe.mul(p.t, xy2d)
+    d = fe.mul_small(p.z, 2)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def _onehot(digit_mag: jnp.ndarray) -> jnp.ndarray:
+    """int32[...batch] in [0,8] -> uint32[9, ...batch] one-hot."""
+    idx = jnp.arange(WINDOW + 1, dtype=jnp.int32).reshape(
+        (WINDOW + 1,) + (1,) * digit_mag.ndim
+    )
+    return (digit_mag[None] == idx).astype(jnp.uint32)
+
+
+def _select_b_niels(digit: jnp.ndarray):
+    """Signed select from the constant basepoint table. digit int32 in [-8,8]."""
+    oh = _onehot(jnp.abs(digit))  # (9, ...batch)
+    tab = _B_NIELS.reshape((WINDOW + 1, 3, fe.NLIMBS) + (1,) * digit.ndim)
+    sel = jnp.sum(tab * oh[:, None, None], axis=0)  # (3, 20, ...batch)
+    yplus, yminus, xy2d = sel[0], sel[1], sel[2]
+    neg = digit < 0
+    yplus2 = fe.select(neg, yminus, yplus)
+    yminus2 = fe.select(neg, yplus, yminus)
+    xy2d2 = fe.select(neg, fe.neg(xy2d), xy2d)
+    return yplus2, yminus2, xy2d2
+
+
+def _select_point_table(tx, ty, tz, tt, digit: jnp.ndarray) -> Point:
+    """Signed select of an extended point from a per-batch table
+    (9, 20, ...batch) per coordinate. Negation: x -> -x, t -> -t."""
+    oh = _onehot(jnp.abs(digit))[:, None]  # (9, 1, ...batch)
+    x = jnp.sum(tx * oh, axis=0)
+    y = jnp.sum(ty * oh, axis=0)
+    z = jnp.sum(tz * oh, axis=0)
+    t = jnp.sum(tt * oh, axis=0)
+    neg = digit < 0
+    return Point(fe.select(neg, fe.neg(x), x), y, z, fe.select(neg, fe.neg(t), t))
+
+
 @jax.jit
 def verify_prepared(
-    a_bytes: jnp.ndarray,  # uint8[32, B] public keys
-    r_bytes: jnp.ndarray,  # uint8[32, B] signature R
-    s_bits: jnp.ndarray,  # uint32[253, B] signature scalar s, LSB-first
-    h_bits: jnp.ndarray,  # uint32[253, B] SHA512(R||A||M) mod L, LSB-first
+    a_bytes: jnp.ndarray,  # uint8[32, ...batch] public keys
+    r_bytes: jnp.ndarray,  # uint8[32, ...batch] signature R
+    s_digits: jnp.ndarray,  # int8[64, ...batch] signed radix-16 digits of s, LSB-first
+    h_digits: jnp.ndarray,  # int8[64, ...batch] digits of SHA512(R||A||M) mod L
 ) -> jnp.ndarray:
-    """Core batched check: enc([s]B + [h](-A)) == enc(R). Returns bool[B]."""
+    """Core batched check: enc([s]B + [h](-A)) == enc(R). Returns bool[...batch]."""
     a_bytes = jnp.asarray(a_bytes)
     r_bytes = jnp.asarray(r_bytes)
-    s_bits = jnp.asarray(s_bits, dtype=jnp.uint32)
-    h_bits = jnp.asarray(h_bits, dtype=jnp.uint32)
+    s_digits = jnp.asarray(s_digits, dtype=jnp.int8).astype(jnp.int32)
+    h_digits = jnp.asarray(h_digits, dtype=jnp.int8).astype(jnp.int32)
     batch = a_bytes.shape[1:]
 
     neg_a, ok_a = decompress(a_bytes)
     neg_a = point_neg(neg_a)
-    bpt = basepoint(batch)
-    b_neg_a = point_add(bpt, neg_a)
-    ident = identity(batch)
 
-    # MSB-first scan over bit pairs.
-    xs = jnp.stack([s_bits[::-1], h_bits[::-1]], axis=1)  # (253, 2, B)
+    # Per-signature table: j*(-A) for j=0..8 (identity, -A, 2(-A), ..., 8(-A)).
+    entries = [identity(batch), neg_a]
+    dbl2 = point_double(neg_a)
+    entries.append(dbl2)
+    for _ in range(3, WINDOW + 1):
+        entries.append(point_add(entries[-1], neg_a))
+    ta_x = jnp.stack([e.x for e in entries])  # (9, 20, ...batch)
+    ta_y = jnp.stack([e.y for e in entries])
+    ta_z = jnp.stack([e.z for e in entries])
+    ta_t = jnp.stack([e.t for e in entries])
 
-    def step(acc: Point, bits):
-        bs, bh = bits[0], bits[1]
-        acc = point_double(acc)
-        with_b = point_select(bs == 1, b_neg_a, neg_a)
-        without_b = point_select(bs == 1, bpt, ident)
-        sel = point_select(bh == 1, with_b, without_b)
-        return point_add(acc, sel), None
+    # MSB-first scan over digit pairs.
+    xs = jnp.stack([s_digits[::-1], h_digits[::-1]], axis=1)  # (64, 2, ...batch)
 
-    acc, _ = jax.lax.scan(step, ident, xs)
+    def step(acc: Point, dd):
+        ds, dh = dd[0], dd[1]
+        acc = point_double(point_double(point_double(point_double(acc))))
+        acc = add_niels(acc, *_select_b_niels(ds))
+        acc = point_add(acc, _select_point_table(ta_x, ta_y, ta_z, ta_t, dh))
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, identity(batch), xs)
     enc = compress(acc)
     return ok_a & jnp.all(enc == r_bytes, axis=0)
