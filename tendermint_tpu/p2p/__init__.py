@@ -1,0 +1,24 @@
+"""P2P fabric: authenticated multiplexed connections, switch/reactor routing,
+peer exchange (reference: p2p/)."""
+
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
+from tendermint_tpu.p2p.key import NodeKey, pubkey_to_id
+from tendermint_tpu.p2p.node_info import NodeInfo, parse_addr
+from tendermint_tpu.p2p.peer import Peer, PeerSet
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.transport import MultiplexTransport
+
+__all__ = [
+    "ChannelDescriptor",
+    "MConnection",
+    "MultiplexTransport",
+    "NodeInfo",
+    "NodeKey",
+    "Peer",
+    "PeerSet",
+    "Reactor",
+    "Switch",
+    "parse_addr",
+    "pubkey_to_id",
+]

@@ -1,0 +1,306 @@
+"""MConnection: one connection per peer multiplexing N priority channels
+(reference: p2p/conn/connection.go:77).
+
+Shape mirrors the reference: per-channel send queues with priorities and a
+most-starved-first scheduler (recentlySent EWMA, reference: :740-830), packets
+of <=1024B payload batched up to 10 per flush (reference: :28-30), flow
+limiting on send+recv (reference: :43-44,507,567), ping/pong keepalive
+(reference: :46-47). Transport is any object with `write(bytes)` /
+`read(n)` coroutines — a SecretConnection or a plain stream adapter.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from tendermint_tpu.libs import protowire as pw
+from tendermint_tpu.libs.flowrate import Monitor
+
+logger = logging.getLogger("tendermint_tpu.p2p")
+
+MAX_PACKET_MSG_PAYLOAD_SIZE = 1024
+NUM_BATCH_PACKET_MSGS = 10
+DEFAULT_SEND_RATE = 512000
+DEFAULT_RECV_RATE = 512000
+PING_INTERVAL = 60.0
+PONG_TIMEOUT = 45.0
+FLUSH_THROTTLE = 0.1
+
+# packet envelope fields (oneof): 1=ping 2=pong 3=msg{1:channel,2:eof,3:data}
+_F_PING, _F_PONG, _F_MSG = 1, 2, 3
+
+
+@dataclass
+class ChannelDescriptor:
+    """(reference: p2p/conn/connection.go ChannelDescriptor)"""
+
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 64
+    recv_message_capacity: int = 22020096  # 21MB, reference default maxMsgSize
+
+
+@dataclass
+class _Channel:
+    desc: ChannelDescriptor
+    send_queue: asyncio.Queue = field(init=False)
+    sending: bytes = b""
+    sent_pos: int = 0
+    recently_sent: float = 0.0  # EWMA for priority scheduling
+    recving: bytearray = field(default_factory=bytearray)
+
+    def __post_init__(self):
+        self.send_queue = asyncio.Queue(maxsize=self.desc.send_queue_capacity)
+
+    def is_send_pending(self) -> bool:
+        return self.sent_pos < len(self.sending) or not self.send_queue.empty()
+
+    def next_packet(self) -> Optional[bytes]:
+        """Pop the next <=1024B packet body for this channel, or None."""
+        if self.sent_pos >= len(self.sending):
+            if self.send_queue.empty():
+                return None
+            self.sending = self.send_queue.get_nowait()
+            self.sent_pos = 0
+        chunk = self.sending[self.sent_pos : self.sent_pos + MAX_PACKET_MSG_PAYLOAD_SIZE]
+        self.sent_pos += len(chunk)
+        eof = self.sent_pos >= len(self.sending)
+        w = pw.Writer()
+        w.varint_field(1, self.desc.id)
+        w.varint_field(2, 1 if eof else 0)
+        w.bytes_field(3, chunk, emit_empty=True)
+        body = w.bytes()
+        self.recently_sent += len(chunk)
+        return body
+
+
+class MConnection:
+    """on_receive(channel_id, msg_bytes) is called for each complete message;
+    on_error(exc) once when the connection dies."""
+
+    def __init__(
+        self,
+        transport,
+        channels: List[ChannelDescriptor],
+        on_receive: Callable[[int, bytes], Awaitable[None]],
+        on_error: Callable[[Exception], Awaitable[None]],
+        send_rate: int = DEFAULT_SEND_RATE,
+        recv_rate: int = DEFAULT_RECV_RATE,
+    ):
+        self._t = transport
+        self._channels: Dict[int, _Channel] = {
+            d.id: _Channel(d) for d in channels
+        }
+        self._on_receive = on_receive
+        self._on_error = on_error
+        self._send_monitor = Monitor()
+        self._recv_monitor = Monitor()
+        self._send_rate = send_rate
+        self._recv_rate = recv_rate
+        self._send_event = asyncio.Event()
+        self._pong_pending = False
+        self._last_pong = time.monotonic()
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = False
+
+    def start(self) -> None:
+        self._tasks = [
+            asyncio.create_task(self._send_routine(), name="mconn-send"),
+            asyncio.create_task(self._recv_routine(), name="mconn-recv"),
+            asyncio.create_task(self._ping_routine(), name="mconn-ping"),
+        ]
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        try:
+            self._t.close()
+        except Exception:
+            pass
+
+    async def send(self, channel_id: int, msg: bytes) -> bool:
+        """Queue msg on the channel; blocks on a full queue (backpressure)
+        (reference: connection.go:350 Send)."""
+        ch = self._channels.get(channel_id)
+        if ch is None or self._stopped:
+            return False
+        await ch.send_queue.put(msg)
+        self._send_event.set()
+        return True
+
+    def try_send(self, channel_id: int, msg: bytes) -> bool:
+        """Non-blocking send; False if the queue is full (reference: :379)."""
+        ch = self._channels.get(channel_id)
+        if ch is None or self._stopped:
+            return False
+        try:
+            ch.send_queue.put_nowait(msg)
+        except asyncio.QueueFull:
+            return False
+        self._send_event.set()
+        return True
+
+    # -- internals ---------------------------------------------------------
+
+    def _pick_channel(self) -> Optional[_Channel]:
+        """Least (recently_sent / priority) among channels with pending data
+        (reference: connection.go sendPacketMsg channel selection)."""
+        best, best_ratio = None, None
+        for ch in self._channels.values():
+            if not ch.is_send_pending():
+                continue
+            ratio = ch.recently_sent / max(ch.desc.priority, 1)
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    async def _send_routine(self) -> None:
+        try:
+            while not self._stopped:
+                await self._send_event.wait()
+                self._send_event.clear()
+                batch = bytearray()
+                n_packets = 0
+                while n_packets < NUM_BATCH_PACKET_MSGS:
+                    ch = self._pick_channel()
+                    if ch is None:
+                        break
+                    body = ch.next_packet()
+                    if body is None:
+                        continue
+                    w = pw.Writer()
+                    w.message_field(_F_MSG, body, always=True)
+                    env = w.bytes()
+                    batch += pw.encode_varint(len(env)) + env
+                    n_packets += 1
+                if batch:
+                    await self._send_monitor.limit(len(batch), self._send_rate)
+                    await self._t.write(bytes(batch))
+                    # decay EWMAs
+                    for ch in self._channels.values():
+                        ch.recently_sent *= 0.8
+                if any(c.is_send_pending() for c in self._channels.values()):
+                    self._send_event.set()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            await self._die(e)
+
+    async def _read_varint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            b = (await self._t.read(1))[0]
+            out |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                return out
+            shift += 7
+            if shift > 63:
+                raise ValueError("varint too long")
+
+    async def _recv_routine(self) -> None:
+        try:
+            while not self._stopped:
+                ln = await self._read_varint()
+                if ln > 8192:
+                    raise ValueError("packet too large")
+                env = await self._t.read(ln)
+                await self._recv_monitor.limit(ln, self._recv_rate)
+                await self._handle_packet(env)
+        except asyncio.CancelledError:
+            raise
+        except (asyncio.IncompleteReadError, ConnectionError) as e:
+            await self._die(e)
+        except Exception as e:
+            await self._die(e)
+
+    async def _handle_packet(self, env: bytes) -> None:
+        for f, _, v in pw.Reader(env):
+            if f == _F_PING:
+                w = pw.Writer()
+                w.message_field(_F_PONG, b"", always=True)
+                out = w.bytes()
+                await self._t.write(pw.encode_varint(len(out)) + out)
+            elif f == _F_PONG:
+                self._last_pong = time.monotonic()
+                self._pong_pending = False
+            elif f == _F_MSG:
+                chan_id, eof, data = 0, 0, b""
+                for ff, _, vv in pw.Reader(v):
+                    if ff == 1:
+                        chan_id = vv
+                    elif ff == 2:
+                        eof = vv
+                    elif ff == 3:
+                        data = vv
+                ch = self._channels.get(chan_id)
+                if ch is None:
+                    raise ValueError(f"unknown channel {chan_id}")
+                ch.recving += data
+                if len(ch.recving) > ch.desc.recv_message_capacity:
+                    raise ValueError("received message exceeds capacity")
+                if eof:
+                    msg = bytes(ch.recving)
+                    ch.recving.clear()
+                    await self._on_receive(chan_id, msg)
+
+    async def _ping_routine(self) -> None:
+        try:
+            while not self._stopped:
+                await asyncio.sleep(PING_INTERVAL)
+                w = pw.Writer()
+                w.message_field(_F_PING, b"", always=True)
+                out = w.bytes()
+                # Arm the flag BEFORE the write: the pong can arrive while the
+                # write awaits, and must not be lost (it would look like a
+                # timeout on a healthy connection).
+                self._pong_pending = True
+                await self._t.write(pw.encode_varint(len(out)) + out)
+                await asyncio.sleep(PONG_TIMEOUT)
+                if self._pong_pending:
+                    raise TimeoutError("pong timeout")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            await self._die(e)
+
+    async def _die(self, e: Exception) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        try:
+            await self._on_error(e)
+        except Exception:
+            logger.exception("on_error callback failed")
+
+
+class StreamTransport:
+    """Plain (unencrypted) adapter with the transport interface MConnection
+    expects — used by tests and in-process nets."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._reader = reader
+        self._writer = writer
+
+    async def write(self, data: bytes) -> None:
+        self._writer.write(data)
+        await self._writer.drain()
+
+    async def read(self, n: int) -> bytes:
+        return await self._reader.readexactly(n)
+
+    def close(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:
+            pass

@@ -1,0 +1,42 @@
+"""Node identity: ed25519 node key, ID = hex(address(pubkey))
+(reference: p2p/key.go)."""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey, PubKey, gen_ed25519
+
+
+def pubkey_to_id(pub: PubKey) -> str:
+    """ID is the hex of the 20-byte address (reference: p2p/key.go PubKeyToID)."""
+    return pub.address().hex()
+
+
+@dataclass
+class NodeKey:
+    priv_key: Ed25519PrivKey
+
+    @property
+    def id(self) -> str:
+        return pubkey_to_id(self.priv_key.pub_key())
+
+    @classmethod
+    def generate(cls) -> "NodeKey":
+        return cls(gen_ed25519())
+
+    @classmethod
+    def load_or_gen(cls, path: str) -> "NodeKey":
+        """(reference: p2p/key.go LoadOrGenNodeKey)"""
+        if os.path.exists(path):
+            with open(path) as f:
+                doc = json.load(f)
+            return cls(Ed25519PrivKey(bytes.fromhex(doc["priv_key"])))
+        nk = cls.generate()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"priv_key": nk.priv_key.bytes().hex()}, f)
+        os.chmod(path, 0o600)
+        return nk

@@ -1,0 +1,74 @@
+"""Peer: a connected remote node (reference: p2p/peer.go), and PeerSet
+(reference: p2p/peer_set.go)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from tendermint_tpu.p2p.conn.connection import MConnection
+from tendermint_tpu.p2p.node_info import NodeInfo
+
+
+class Peer:
+    def __init__(
+        self,
+        node_info: NodeInfo,
+        mconn: MConnection,
+        outbound: bool,
+        persistent: bool = False,
+        socket_addr: str = "",
+    ):
+        self.node_info = node_info
+        self.mconn = mconn
+        self.outbound = outbound
+        self.persistent = persistent
+        self.socket_addr = socket_addr
+        self._data: Dict[str, object] = {}  # reactor-attached state (PeerState)
+
+    @property
+    def id(self) -> str:
+        return self.node_info.node_id
+
+    async def send(self, chan_id: int, msg: bytes) -> bool:
+        return await self.mconn.send(chan_id, msg)
+
+    def try_send(self, chan_id: int, msg: bytes) -> bool:
+        return self.mconn.try_send(chan_id, msg)
+
+    def set(self, key: str, value) -> None:
+        self._data[key] = value
+
+    def get(self, key: str):
+        return self._data.get(key)
+
+    async def stop(self) -> None:
+        await self.mconn.stop()
+
+    def __repr__(self) -> str:
+        return f"Peer({self.id[:10]}, {'out' if self.outbound else 'in'})"
+
+
+class PeerSet:
+    def __init__(self):
+        self._peers: Dict[str, Peer] = {}
+
+    def add(self, peer: Peer) -> None:
+        if peer.id in self._peers:
+            raise ValueError(f"duplicate peer {peer.id}")
+        self._peers[peer.id] = peer
+
+    def has(self, peer_id: str) -> bool:
+        return peer_id in self._peers
+
+    def get(self, peer_id: str) -> Optional[Peer]:
+        return self._peers.get(peer_id)
+
+    def remove(self, peer_id: str) -> Optional[Peer]:
+        return self._peers.pop(peer_id, None)
+
+    def list(self) -> List[Peer]:
+        return list(self._peers.values())
+
+    def size(self) -> int:
+        return len(self._peers)

@@ -1,0 +1,199 @@
+"""Switch: owns reactors and peers, routes channels, handles the peer
+lifecycle (reference: p2p/switch.go:68).
+
+accept_routine takes upgraded connections from the transport; add_peer wires
+an MConnection whose on_receive dispatches to the reactor registered for the
+channel (reference: p2p/switch.go:157 AddReactor, :788 addPeer). Persistent
+peers are re-dialed with exponential backoff (reference: :379 reconnectToPeer).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Dict, List, Optional
+
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
+from tendermint_tpu.p2p.node_info import parse_addr
+from tendermint_tpu.p2p.peer import Peer, PeerSet
+from tendermint_tpu.p2p.transport import Connection, MultiplexTransport
+
+logger = logging.getLogger("tendermint_tpu.p2p")
+
+RECONNECT_ATTEMPTS = 20
+RECONNECT_BASE_DELAY = 0.5
+
+
+class Switch:
+    def __init__(self, transport: MultiplexTransport, max_peers: int = 50):
+        self.transport = transport
+        self.peers = PeerSet()
+        self.reactors: Dict[str, Reactor] = {}
+        self._chan_to_reactor: Dict[int, Reactor] = {}
+        self._channel_descs: List[ChannelDescriptor] = []
+        self.max_peers = max_peers
+        self.persistent_addrs: Dict[str, str] = {}  # peer id -> addr
+        self._tasks: List[asyncio.Task] = []
+        self._running = False
+        self._dialing: set[str] = set()
+
+    @property
+    def node_info(self):
+        return self.transport.node_info
+
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        """(reference: p2p/switch.go:157 AddReactor)"""
+        for desc in reactor.get_channels():
+            if desc.id in self._chan_to_reactor:
+                raise ValueError(f"channel {desc.id:#x} already registered")
+            self._chan_to_reactor[desc.id] = reactor
+            self._channel_descs.append(desc)
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+        # advertise channels in NodeInfo
+        self.transport.node_info.channels = bytes(
+            sorted(self._chan_to_reactor.keys())
+        )
+        return reactor
+
+    async def start(self) -> None:
+        self._running = True
+        for reactor in self.reactors.values():
+            await reactor.start()
+        self._tasks.append(asyncio.create_task(self._accept_routine(), name="sw-accept"))
+
+    async def stop(self) -> None:
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        for peer in self.peers.list():
+            await self._stop_and_remove_peer(peer, None)
+        for reactor in self.reactors.values():
+            await reactor.stop()
+        await self.transport.close()
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    async def _accept_routine(self) -> None:
+        while self._running:
+            try:
+                conn = await self.transport.accept()
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                logger.error("accept error: %s", e)
+                continue
+            if self.peers.size() >= self.max_peers:
+                conn.transport.close()
+                continue
+            try:
+                await self._add_peer(conn)
+            except Exception as e:
+                logger.info("failed to add inbound peer: %s", e)
+
+    async def dial_peer(self, addr: str, persistent: bool = False) -> Optional[Peer]:
+        """Dial 'id@host:port' and add the peer."""
+        peer_id, _, _ = parse_addr(addr)
+        if peer_id and (self.peers.has(peer_id) or peer_id in self._dialing):
+            return self.peers.get(peer_id)
+        self._dialing.add(peer_id)
+        try:
+            conn = await self.transport.dial(addr)
+            if persistent:
+                self.persistent_addrs[conn.node_info.node_id] = addr
+            return await self._add_peer(conn, persistent=persistent)
+        finally:
+            self._dialing.discard(peer_id)
+
+    async def dial_peers_async(self, addrs: List[str], persistent: bool = False) -> None:
+        async def _one(a):
+            try:
+                await self.dial_peer(a, persistent=persistent)
+            except Exception as e:
+                logger.info("dial %s failed: %s", a, e)
+                if persistent:
+                    pid, _, _ = parse_addr(a)
+                    self._tasks.append(
+                        asyncio.create_task(self._reconnect_routine(a, pid))
+                    )
+
+        await asyncio.gather(*(_one(a) for a in addrs))
+
+    async def _add_peer(self, conn: Connection, persistent: bool = False) -> Peer:
+        ni = conn.node_info
+        if self.peers.has(ni.node_id):
+            conn.transport.close()
+            raise ValueError(f"duplicate peer {ni.node_id}")
+        persistent = persistent or ni.node_id in self.persistent_addrs
+
+        peer_holder: List[Peer] = []
+
+        async def on_receive(chan_id: int, msg: bytes) -> None:
+            reactor = self._chan_to_reactor.get(chan_id)
+            if reactor is None:
+                raise ValueError(f"no reactor for channel {chan_id:#x}")
+            await reactor.receive(chan_id, peer_holder[0], msg)
+
+        async def on_error(e: Exception) -> None:
+            await self.stop_peer_for_error(peer_holder[0], e)
+
+        mconn = MConnection(conn.transport, self._channel_descs, on_receive, on_error)
+        peer = Peer(ni, mconn, conn.outbound, persistent, conn.socket_addr)
+        peer_holder.append(peer)
+        self.peers.add(peer)
+        mconn.start()
+        for reactor in self.reactors.values():
+            await reactor.add_peer(peer)
+        logger.info("added peer %s (%s)", ni.node_id[:10], ni.moniker)
+        return peer
+
+    async def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        """(reference: p2p/switch.go:324 StopPeerForError)"""
+        if not self.peers.has(peer.id):
+            return
+        logger.info("stopping peer %s: %s", peer.id[:10], reason)
+        await self._stop_and_remove_peer(peer, reason)
+        if peer.persistent and self._running:
+            addr = self.persistent_addrs.get(peer.id) or (
+                f"{peer.id}@{peer.socket_addr}" if peer.outbound else None
+            )
+            if addr:
+                self._tasks.append(
+                    asyncio.create_task(self._reconnect_routine(addr, peer.id))
+                )
+
+    async def _stop_and_remove_peer(self, peer: Peer, reason) -> None:
+        self.peers.remove(peer.id)
+        await peer.stop()
+        for reactor in self.reactors.values():
+            try:
+                await reactor.remove_peer(peer, reason)
+            except Exception:
+                logger.exception("reactor remove_peer failed")
+
+    async def _reconnect_routine(self, addr: str, peer_id: str) -> None:
+        """Exponential backoff reconnect (reference: p2p/switch.go:379)."""
+        for attempt in range(RECONNECT_ATTEMPTS):
+            if not self._running or self.peers.has(peer_id):
+                return
+            delay = RECONNECT_BASE_DELAY * (2 ** min(attempt, 6)) * (0.5 + random.random())
+            await asyncio.sleep(delay)
+            try:
+                await self.dial_peer(addr, persistent=True)
+                return
+            except Exception as e:
+                logger.debug("reconnect %s attempt %d failed: %s", addr, attempt, e)
+
+    # -- broadcast ---------------------------------------------------------
+
+    async def broadcast(self, chan_id: int, msg: bytes) -> None:
+        """Async send to every peer (reference: p2p/switch.go:263)."""
+        await asyncio.gather(
+            *(p.send(chan_id, msg) for p in self.peers.list()),
+            return_exceptions=True,
+        )
+
+    def num_peers(self) -> int:
+        return self.peers.size()

@@ -1,0 +1,205 @@
+"""P2P fabric tests: secret connection, MConnection multiplexing, switch
+routing, persistent reconnect (reference test models: p2p/conn/*_test.go,
+p2p/switch_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto import gen_ed25519
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    NodeInfo,
+    NodeKey,
+    Reactor,
+    Switch,
+    MultiplexTransport,
+)
+from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
+
+
+class EchoReactor(Reactor):
+    """Records every message; echoes on channel 0x02."""
+
+    def __init__(self, name):
+        super().__init__(name)
+        self.received = []
+        self.peers_added = []
+        self.peers_removed = []
+        self.got = asyncio.Event()
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(id=0x01, priority=5),
+            ChannelDescriptor(id=0x02, priority=1),
+        ]
+
+    async def add_peer(self, peer):
+        self.peers_added.append(peer.id)
+
+    async def remove_peer(self, peer, reason):
+        self.peers_removed.append(peer.id)
+
+    async def receive(self, chan_id, peer, msg):
+        self.received.append((chan_id, msg))
+        self.got.set()
+        if chan_id == 0x02:
+            await peer.send(0x01, b"echo:" + msg)
+
+
+def make_switch(name: str, chain="p2p-test", secret=True):
+    nk = NodeKey(gen_ed25519())
+    ni = NodeInfo(node_id=nk.id, network=chain, moniker=name)
+    transport = MultiplexTransport(nk, ni, use_secret_conn=secret)
+    sw = Switch(transport)
+    reactor = EchoReactor(f"echo-{name}")
+    sw.add_reactor("echo", reactor)
+    return sw, reactor
+
+
+async def start_pair(secret=True):
+    sw1, r1 = make_switch("alice", secret=secret)
+    sw2, r2 = make_switch("bob", secret=secret)
+    await sw1.start()
+    await sw2.start()
+    addr = await sw1.transport.listen("127.0.0.1", 0)
+    await sw2.dial_peer(f"{sw1.node_info.node_id}@{addr}")
+    for _ in range(100):
+        if sw1.num_peers() and sw2.num_peers():
+            break
+        await asyncio.sleep(0.02)
+    return sw1, r1, sw2, r2
+
+
+def test_secret_connection_handshake_and_frames():
+    async def run():
+        k1, k2 = gen_ed25519(), gen_ed25519()
+        server_done = asyncio.get_event_loop().create_future()
+
+        async def on_conn(reader, writer):
+            sc = await SecretConnection.upgrade(reader, writer, k1)
+            msg = await sc.read_msg()
+            await sc.write_msg(b"pong:" + msg)
+            server_done.set_result(sc.remote_pubkey.bytes())
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        sc = await SecretConnection.upgrade(reader, writer, k2)
+        # remote identity is authenticated
+        assert sc.remote_pubkey.bytes() == k1.pub_key().bytes()
+        big = bytes(range(256)) * 20  # multi-frame message (5120 bytes)
+        await sc.write_msg(big)
+        resp = await sc.read_msg()
+        assert resp == b"pong:" + big
+        assert await server_done == k2.pub_key().bytes()
+        server.close()
+
+    asyncio.run(run())
+
+
+def test_switch_connects_and_routes_channels():
+    async def run():
+        sw1, r1, sw2, r2 = await start_pair()
+        try:
+            assert sw1.num_peers() == 1 and sw2.num_peers() == 1
+            # send on channel 2 -> bob echoes back on channel 1
+            peer = sw2.peers.list()[0]
+            await peer.send(0x02, b"hello")
+            await asyncio.wait_for(r2.got.wait(), 5)
+            for _ in range(100):
+                if r2.received:
+                    break
+                await asyncio.sleep(0.02)
+            assert (0x02, b"hello") in r1.received
+            for _ in range(100):
+                if r2.received:
+                    break
+                await asyncio.sleep(0.02)
+            assert (0x01, b"echo:hello") in r2.received
+            # broadcast
+            await sw1.broadcast(0x01, b"blast")
+            for _ in range(100):
+                if (0x01, b"blast") in r2.received:
+                    break
+                await asyncio.sleep(0.02)
+            assert (0x01, b"blast") in r2.received
+        finally:
+            await sw2.stop()
+            await sw1.stop()
+
+    asyncio.run(run())
+
+
+def test_large_message_multiplexed():
+    async def run():
+        sw1, r1, sw2, r2 = await start_pair()
+        try:
+            big = bytes(range(256)) * 300  # 76800 bytes > 75 packets
+            peer = sw2.peers.list()[0]
+            await peer.send(0x01, big)
+            for _ in range(200):
+                if any(m == big for _, m in r1.received):
+                    break
+                await asyncio.sleep(0.02)
+            assert any(m == big for _, m in r1.received)
+        finally:
+            await sw2.stop()
+            await sw1.stop()
+
+    asyncio.run(run())
+
+
+def test_peer_removal_on_disconnect():
+    async def run():
+        sw1, r1, sw2, r2 = await start_pair()
+        try:
+            peer = sw1.peers.list()[0]
+            await sw1.stop_peer_for_error(peer, "test kill")
+            assert sw1.num_peers() == 0
+            assert r1.peers_removed == [peer.id]
+            # bob notices the dead connection eventually
+            for _ in range(200):
+                if sw2.num_peers() == 0:
+                    break
+                await asyncio.sleep(0.02)
+            assert sw2.num_peers() == 0
+        finally:
+            await sw2.stop()
+            await sw1.stop()
+
+    asyncio.run(run())
+
+
+def test_node_info_incompatible_network_rejected():
+    async def run():
+        sw1, _ = make_switch("alice", chain="chain-A")
+        sw2, _ = make_switch("bob", chain="chain-B")
+        await sw1.start()
+        await sw2.start()
+        addr = await sw1.transport.listen("127.0.0.1", 0)
+        with pytest.raises(Exception):
+            await sw2.dial_peer(f"{sw1.node_info.node_id}@{addr}")
+        assert sw2.num_peers() == 0
+        await asyncio.sleep(0.1)
+        assert sw1.num_peers() == 0
+        await sw2.stop()
+        await sw1.stop()
+
+    asyncio.run(run())
+
+
+def test_dial_wrong_id_rejected():
+    async def run():
+        sw1, _ = make_switch("alice")
+        sw2, _ = make_switch("bob")
+        await sw1.start()
+        await sw2.start()
+        addr = await sw1.transport.listen("127.0.0.1", 0)
+        wrong_id = "ab" * 20
+        with pytest.raises(Exception):
+            await sw2.dial_peer(f"{wrong_id}@{addr}")
+        await sw2.stop()
+        await sw1.stop()
+
+    asyncio.run(run())
