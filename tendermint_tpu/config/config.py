@@ -183,4 +183,5 @@ def test_config() -> Config:
     cfg.consensus.timeout_precommit_delta = 0.1
     cfg.consensus.timeout_commit = 0.1
     cfg.consensus.skip_timeout_commit = True
+    cfg.p2p.laddr = ""  # tests opt in to p2p with an explicit port
     return cfg

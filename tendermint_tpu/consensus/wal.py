@@ -125,8 +125,14 @@ class WAL:
         self.head_size_limit = head_size_limit
         self.total_size_limit = total_size_limit
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fresh = not os.path.exists(path) or os.path.getsize(path) == 0
         self._fh = open(path, "ab")
         self._flushed = True
+        if fresh and len(self._all_files()) <= 1:
+            # Empty WAL: mark "height 0 done" so catchup replay after a crash
+            # mid-height-1 finds its search anchor (reference: consensus/wal.go
+            # OnStart writes EndHeightMessage{0} into an empty group).
+            self.write_end_height(0)
 
     # -- writing ------------------------------------------------------------
 

@@ -1,0 +1,85 @@
+"""Evidence reactor: gossips pending evidence on channel 0x38
+(reference: evidence/reactor.go:16)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List
+
+from tendermint_tpu.libs import protowire as pw
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, decode_evidence
+
+logger = logging.getLogger("tendermint_tpu.evidence")
+
+EVIDENCE_CHANNEL = 0x38
+BROADCAST_SLEEP = 0.1
+
+
+def encode_evidence_list(evs: List[DuplicateVoteEvidence]) -> bytes:
+    w = pw.Writer()
+    for ev in evs:
+        w.message_field(1, ev.encode(), always=True)
+    return w.bytes()
+
+
+def decode_evidence_list(data: bytes) -> List[DuplicateVoteEvidence]:
+    return [decode_evidence(v) for f, _, v in pw.Reader(data) if f == 1]
+
+
+class EvidenceReactor(Reactor):
+    def __init__(self, evpool):
+        super().__init__("EVIDENCE")
+        self.evpool = evpool
+        self._peer_tasks: Dict[str, asyncio.Task] = {}
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [ChannelDescriptor(EVIDENCE_CHANNEL, priority=6, send_queue_capacity=10)]
+
+    async def add_peer(self, peer) -> None:
+        self._peer_tasks[peer.id] = asyncio.create_task(
+            self._broadcast_routine(peer), name=f"ev-bcast-{peer.id[:8]}"
+        )
+
+    async def remove_peer(self, peer, reason) -> None:
+        t = self._peer_tasks.pop(peer.id, None)
+        if t:
+            t.cancel()
+
+    async def stop(self) -> None:
+        for t in self._peer_tasks.values():
+            t.cancel()
+        self._peer_tasks.clear()
+
+    async def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            evs = decode_evidence_list(msg_bytes)
+        except Exception as e:
+            logger.error("bad evidence msg from %s: %s", peer.id[:10], e)
+            await self.switch.stop_peer_for_error(peer, e)
+            return
+        for ev in evs:
+            try:
+                self.evpool.add_evidence(ev)
+            except Exception as e:
+                logger.info("rejected evidence from %s: %s", peer.id[:10], e)
+
+    async def _broadcast_routine(self, peer) -> None:
+        """Periodically offer all pending evidence the peer may lack
+        (reference: evidence/reactor.go broadcastEvidenceRoutine)."""
+        sent: set = set()
+        try:
+            while True:
+                pending = self.evpool.pending_evidence(-1)
+                fresh = [ev for ev in pending if ev.hash() not in sent]
+                if fresh:
+                    ok = await peer.send(EVIDENCE_CHANNEL, encode_evidence_list(fresh))
+                    if ok:
+                        sent.update(ev.hash() for ev in fresh)
+                await asyncio.sleep(BROADCAST_SLEEP)
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("evidence broadcast died for %s", peer.id[:10])

@@ -32,6 +32,7 @@ class MempoolTx:
     tx: bytes
     height: int  # height when validated
     gas_wanted: int
+    senders: frozenset = frozenset()  # peer IDs that sent us this tx
 
 
 class Mempool:
@@ -105,24 +106,45 @@ class Mempool:
             self._cache.popitem(last=False)
         return True
 
-    def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
-        """(reference: mempool/clist_mempool.go:234 CheckTx + resCbFirstTime :404)"""
+    def check_tx(self, tx: bytes, sender: str = "") -> Optional[abci.ResponseCheckTx]:
+        """(reference: mempool/clist_mempool.go:234 CheckTx + resCbFirstTime :404)
+
+        sender: peer ID for gossiped txs (recorded so the reactor does not
+        echo the tx back, reference: mempool/reactor.go:41-96). A tx already
+        in the cache from a peer returns None instead of raising (the
+        reference updates the sender list and drops it silently)."""
         with self._lock:
             if self.is_full(len(tx)):
+                if sender:
+                    return None
                 raise MempoolError("mempool is full")
             key = tmhash.sum256(tx)
             if not self._cache_push(key):
+                mtx = self._txs.get(key)
+                if mtx is not None and sender:
+                    mtx.senders = mtx.senders | {sender}
+                    return None
+                if sender:
+                    return None
                 raise TxInCacheError()
             res = self.proxy_app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
             if res.code == abci.CODE_TYPE_OK:
                 if key not in self._txs:
-                    self._txs[key] = MempoolTx(tx=tx, height=self._height, gas_wanted=res.gas_wanted)
+                    self._txs[key] = MempoolTx(
+                        tx=tx, height=self._height, gas_wanted=res.gas_wanted,
+                        senders=frozenset({sender}) if sender else frozenset(),
+                    )
                     self._total_bytes += len(tx)
                     self._notify_txs_available()
             else:
                 if not self.keep_invalid_txs_in_cache:
                     self._cache.pop(key, None)
             return res
+
+    def entries(self) -> List[tuple]:
+        """Snapshot [(key, tx, senders)] in insertion order (gossip walk)."""
+        with self._lock:
+            return [(k, m.tx, m.senders) for k, m in self._txs.items()]
 
     # -- proposals ----------------------------------------------------------
 

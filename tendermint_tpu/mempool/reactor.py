@@ -1,0 +1,97 @@
+"""Mempool reactor: gossips transactions on channel 0x30
+(reference: mempool/reactor.go:18,190).
+
+Per-peer broadcast task walks the mempool's tx list by insertion order and
+skips txs the peer sent us (peer-ID tracking, reference: :41-96 mempoolIDs)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List
+
+from tendermint_tpu.libs import protowire as pw
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+
+logger = logging.getLogger("tendermint_tpu.mempool")
+
+MEMPOOL_CHANNEL = 0x30
+BROADCAST_SLEEP = 0.02
+
+
+def encode_txs(txs: List[bytes]) -> bytes:
+    w = pw.Writer()
+    for tx in txs:
+        w.bytes_field(1, tx, emit_empty=True)
+    return w.bytes()
+
+
+def decode_txs(data: bytes) -> List[bytes]:
+    return [v for f, _, v in pw.Reader(data) if f == 1]
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool, broadcast: bool = True):
+        super().__init__("MEMPOOL")
+        self.mempool = mempool
+        self.broadcast = broadcast
+        self._peer_tasks: Dict[str, asyncio.Task] = {}
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5, send_queue_capacity=128)]
+
+    async def add_peer(self, peer) -> None:
+        if self.broadcast:
+            self._peer_tasks[peer.id] = asyncio.create_task(
+                self._broadcast_tx_routine(peer), name=f"mempool-bcast-{peer.id[:8]}"
+            )
+
+    async def remove_peer(self, peer, reason) -> None:
+        t = self._peer_tasks.pop(peer.id, None)
+        if t:
+            t.cancel()
+
+    async def stop(self) -> None:
+        for t in self._peer_tasks.values():
+            t.cancel()
+        self._peer_tasks.clear()
+
+    async def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        loop = asyncio.get_running_loop()
+        for tx in decode_txs(msg_bytes):
+            # check_tx holds the mempool lock and calls the app synchronously;
+            # run off-loop so a slow CheckTx can't stall all p2p/consensus I/O
+            # (same policy as the RPC broadcast path).
+            try:
+                await loop.run_in_executor(None, self.mempool.check_tx, tx, peer.id)
+            except Exception as e:
+                logger.debug("gossiped tx rejected: %s", e)
+
+    async def _broadcast_tx_routine(self, peer) -> None:
+        """(reference: mempool/reactor.go:190 broadcastTxRoutine)"""
+        sent: set = set()
+        try:
+            while True:
+                entries = self.mempool.entries()
+                progress = False
+                for key, tx, senders in entries:
+                    if key in sent:
+                        continue
+                    if peer.id in senders:
+                        sent.add(key)  # peer gave it to us; skip
+                        continue
+                    ok = await peer.send(MEMPOOL_CHANNEL, encode_txs([tx]))
+                    if ok:
+                        sent.add(key)
+                        progress = True
+                if not progress:
+                    await asyncio.sleep(BROADCAST_SLEEP)
+                # GC the sent-set against the live mempool
+                if len(sent) > 10000:
+                    live = {k for k, _, _ in self.mempool.entries()}
+                    sent &= live
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("mempool broadcast died for %s", peer.id[:10])

@@ -121,11 +121,12 @@ class Node:
         )
 
         # consensus
-        wal_path = (
-            os.path.join(config.root_dir, config.consensus.wal_path)
-            if config.root_dir
-            else os.path.join(os.getcwd(), ".tmp_wal", "wal")
-        )
+        if os.path.isabs(config.consensus.wal_path):
+            wal_path = config.consensus.wal_path
+        elif config.root_dir:
+            wal_path = os.path.join(config.root_dir, config.consensus.wal_path)
+        else:
+            wal_path = os.path.join(os.getcwd(), ".tmp_wal", "wal")
         self.wal = WAL(wal_path)
         self.consensus = ConsensusState(
             config.consensus,
@@ -142,10 +143,51 @@ class Node:
         self.rpc_server = None
         self._running = False
 
+        # p2p (reference: node/node.go:754-793 createTransport/createSwitch)
+        self.switch = None
+        self.node_key = None
+        self.consensus_reactor = None
+        if config.p2p.laddr:
+            from tendermint_tpu.consensus.reactor import ConsensusReactor
+            from tendermint_tpu.evidence.reactor import EvidenceReactor
+            from tendermint_tpu.mempool.reactor import MempoolReactor
+            from tendermint_tpu.p2p import (
+                MultiplexTransport,
+                NodeInfo,
+                NodeKey,
+                Switch,
+            )
+
+            if config.root_dir:
+                self.node_key = NodeKey.load_or_gen(
+                    os.path.join(config.root_dir, "config", "node_key.json")
+                )
+            else:
+                self.node_key = NodeKey.generate()
+            node_info = NodeInfo(
+                node_id=self.node_key.id,
+                listen_addr=config.p2p.laddr,
+                network=genesis.chain_id,
+                moniker=config.base.moniker,
+            )
+            transport = MultiplexTransport(self.node_key, node_info)
+            self.switch = Switch(transport)
+            self.consensus_reactor = ConsensusReactor(self.consensus)
+            self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+            self.switch.add_reactor("MEMPOOL", MempoolReactor(self.mempool))
+            self.switch.add_reactor("EVIDENCE", EvidenceReactor(self.evidence_pool))
+
     async def start(self) -> None:
         self._running = True
         await self.indexer_service.start()
         await self.consensus.start()
+        if self.switch is not None:
+            await self.switch.start()
+            host, port = self._parse_laddr(self.config.p2p.laddr)
+            self.p2p_addr = await self.switch.transport.listen(host, port)
+            if self.config.p2p.persistent_peers:
+                peers = [a.strip() for a in self.config.p2p.persistent_peers.split(",") if a.strip()]
+                await self.switch.dial_peers_async(peers, persistent=True)
         if self.config.rpc.laddr:
             from tendermint_tpu.rpc.server import RPCServer
 
@@ -153,10 +195,18 @@ class Node:
             await self.rpc_server.start()
         logger.info("node started (chain %s)", self.genesis.chain_id)
 
+    @staticmethod
+    def _parse_laddr(laddr: str) -> tuple:
+        addr = laddr.split("://", 1)[-1]
+        host, _, port = addr.rpartition(":")
+        return host or "127.0.0.1", int(port)
+
     async def stop(self) -> None:
         self._running = False
         if self.rpc_server is not None:
             await self.rpc_server.stop()
+        if self.switch is not None:
+            await self.switch.stop()
         await self.consensus.stop()
         await self.indexer_service.stop()
         self.proxy_app.stop()

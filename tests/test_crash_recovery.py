@@ -1,0 +1,99 @@
+"""Fail-point crash-recovery matrix (reference:
+test/persist/test_failure_indices.sh:40).
+
+For each fail index i, run a subprocess node with TMTPU_FAIL_INDEX=i. The
+node crashes hard (os._exit) at the i-th fail point hit during the
+commit/apply sequence. The node is then restarted WITHOUT the fail index and
+must recover (WAL catchup + handshake replay) and keep committing blocks."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+CHILD_SCRIPT = r"""
+import asyncio, os, sys
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+from tendermint_tpu.abci.kvstore import PersistentKVStoreApplication
+from tendermint_tpu.config.config import test_config
+from tendermint_tpu.crypto import gen_ed25519
+from tendermint_tpu.node.node import Node
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+root = sys.argv[1]
+target_height = int(sys.argv[2])
+os.makedirs(os.path.join(root, "data"), exist_ok=True)
+
+cfg = test_config()
+cfg.base.db_backend = "sqlite"
+cfg.rpc.laddr = ""
+cfg.p2p.laddr = ""
+cfg.root_dir = root
+priv = FilePV(gen_ed25519(b"\x21" * 32),
+              key_file=os.path.join(root, "pv_key.json"),
+              state_file=os.path.join(root, "pv_state.json"))
+gen = GenesisDoc(chain_id="crash-chain", validators=[GenesisValidator(priv.get_pub_key(), 10)])
+
+from tendermint_tpu.libs.kvdb import SQLiteDB
+
+async def run():
+    app = PersistentKVStoreApplication(SQLiteDB(os.path.join(root, "data", "app.db")))
+    node = Node(cfg, gen, priv_validator=priv, app=app)
+    await node.start()
+    # feed a tx each height so blocks are non-empty
+    try:
+        node.mempool.check_tx(b"k%d=v" % node.block_store.height)
+    except Exception:
+        pass
+    await node.wait_for_height(target_height, timeout=45)
+    h = node.block_store.height
+    await node.stop()
+    print(f"REACHED {h}", flush=True)
+
+asyncio.run(run())
+"""
+
+
+def run_child(root: str, target: int, fail_index: int | None, timeout=90):
+    env = dict(os.environ)
+    env["TMTPU_CRYPTO_BACKEND"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("TMTPU_FAIL_INDEX", None)
+    if fail_index is not None:
+        env["TMTPU_FAIL_INDEX"] = str(fail_index)
+    proc = subprocess.run(
+        [sys.executable, "-c", CHILD_SCRIPT, root, str(target)],
+        env=env,
+        capture_output=True,
+        timeout=timeout,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    return proc
+
+
+# Fail points hit per height (cs_state + execution): index 0..5 covers the
+# full commit/apply ordering: before save block, after save block, after WAL
+# EndHeight, after apply block, and the execution-internal points.
+@pytest.mark.parametrize("fail_index", [0, 1, 2, 3, 4, 5])
+def test_crash_at_fail_index_then_recover(tmp_path, fail_index):
+    root = str(tmp_path / f"node_fi{fail_index}")
+    os.makedirs(os.path.join(root, "data"), exist_ok=True)
+
+    # phase 1: run with the fail index armed; expect the hard crash (77)
+    proc = run_child(root, target=4, fail_index=fail_index)
+    assert proc.returncode == 77, (
+        f"expected crash at fail point {fail_index}; rc={proc.returncode}\n"
+        f"stdout={proc.stdout}\nstderr={proc.stderr[-2000:]}"
+    )
+
+    # phase 2: restart without the fail index; must recover and commit
+    proc2 = run_child(root, target=3, fail_index=None)
+    assert proc2.returncode == 0, (
+        f"recovery failed after crash at {fail_index}; rc={proc2.returncode}\n"
+        f"stdout={proc2.stdout}\nstderr={proc2.stderr[-3000:]}"
+    )
+    assert "REACHED" in proc2.stdout
