@@ -1,0 +1,167 @@
+"""BlockPool: concurrent per-height block requesters for fast sync
+(reference: blockchain/v0/pool.go:62,107).
+
+The pool tracks peers' reported heights, keeps up to `request_window` heights
+in flight, assigns each height to a peer, and exposes a sliding window of
+downloaded blocks to the reactor (peek_two_blocks / pop_request). A peer that
+times out or sends a bad block is punished and its heights redone."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+logger = logging.getLogger("tendermint_tpu.blocksync")
+
+REQUEST_WINDOW = 40  # max heights in flight (reference: maxPendingRequests-ish)
+PEER_TIMEOUT = 10.0
+RETRY_SLEEP = 0.05
+
+
+@dataclass
+class _PoolPeer:
+    peer_id: str
+    height: int = 0
+    base: int = 0
+    pending: int = 0
+    did_timeout: bool = False
+
+
+@dataclass
+class _Requester:
+    height: int
+    peer_id: str = ""
+    block: Optional[object] = None
+    requested_at: float = field(default_factory=lambda: time.monotonic())
+
+
+class BlockPool:
+    def __init__(self, start_height: int, send_request: Callable, punish_peer: Callable):
+        """send_request(peer_id, height) -> awaitable; punish_peer(peer_id, reason)."""
+        self.height = start_height  # next height to pop
+        self._peers: Dict[str, _PoolPeer] = {}
+        self._requesters: Dict[int, _Requester] = {}
+        self._send_request = send_request
+        self._punish_peer = punish_peer
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+
+    def start(self) -> None:
+        self._running = True
+        self._task = asyncio.create_task(self._make_requests_routine(), name="pool-requests")
+
+    def stop(self) -> None:
+        self._running = False
+        if self._task:
+            self._task.cancel()
+
+    # -- peers -------------------------------------------------------------
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        p = self._peers.get(peer_id)
+        if p is None:
+            p = self._peers[peer_id] = _PoolPeer(peer_id)
+        p.base, p.height = base, height
+
+    def remove_peer(self, peer_id: str) -> None:
+        self._peers.pop(peer_id, None)
+        for req in self._requesters.values():
+            if req.peer_id == peer_id and req.block is None:
+                req.peer_id = ""
+                req.requested_at = time.monotonic()
+
+    def max_peer_height(self) -> int:
+        return max((p.height for p in self._peers.values()), default=0)
+
+    def num_peers(self) -> int:
+        return len(self._peers)
+
+    # -- blocks ------------------------------------------------------------
+
+    def add_block(self, peer_id: str, block) -> bool:
+        req = self._requesters.get(block.header.height)
+        if req is None or req.block is not None:
+            return False
+        if req.peer_id != peer_id:
+            # only the assigned requester's peer may fill the slot — otherwise
+            # a bad block is unattributable and an attacker can pre-fill
+            # heights with junk that is never re-requested (reference:
+            # pool.go AddBlock checks the requester's peer)
+            return False
+        req.block = block
+        p = self._peers.get(peer_id)
+        if p:
+            p.pending = max(0, p.pending - 1)
+        return True
+
+    def get_block(self, height: int):
+        """Downloaded block at height, or None."""
+        req = self._requesters.get(height)
+        return req.block if req else None
+
+    def pop_request(self) -> None:
+        """first block was applied: advance (reference: pool.go PopRequest)."""
+        self._requesters.pop(self.height, None)
+        self.height += 1
+
+    def redo_request(self, height: int) -> str:
+        """first/second failed validation: punish the sender, refetch
+        (reference: pool.go RedoRequest)."""
+        req = self._requesters.get(height)
+        if req is None:
+            return ""
+        bad_peer = req.peer_id
+        req.block = None
+        req.peer_id = ""
+        req.requested_at = time.monotonic()
+        return bad_peer
+
+    # -- request scheduling -------------------------------------------------
+
+    def _pick_peer(self, height: int) -> Optional[_PoolPeer]:
+        candidates = [
+            p for p in self._peers.values()
+            if p.base <= height <= p.height and p.pending < 20
+        ]
+        if not candidates:
+            return None
+        return random.choice(candidates)
+
+    async def _make_requests_routine(self) -> None:
+        try:
+            while self._running:
+                # spawn requesters for the window
+                max_h = self.max_peer_height()
+                next_h = self.height
+                while (
+                    len(self._requesters) < REQUEST_WINDOW
+                    and next_h <= max_h
+                ):
+                    if next_h not in self._requesters:
+                        self._requesters[next_h] = _Requester(next_h, "")
+                    next_h += 1
+                # assign unassigned / timed-out requesters
+                now = time.monotonic()
+                for req in list(self._requesters.values()):
+                    if req.block is not None:
+                        continue
+                    if req.peer_id and now - req.requested_at > PEER_TIMEOUT:
+                        await self._punish_peer(req.peer_id, "block request timeout")
+                        self.remove_peer(req.peer_id)
+                    if not req.peer_id:
+                        peer = self._pick_peer(req.height)
+                        if peer is None:
+                            continue
+                        req.peer_id = peer.peer_id
+                        req.requested_at = now
+                        peer.pending += 1
+                        await self._send_request(peer.peer_id, req.height)
+                await asyncio.sleep(RETRY_SLEEP)
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("pool request routine died")

@@ -147,6 +147,8 @@ class Node:
         self.switch = None
         self.node_key = None
         self.consensus_reactor = None
+        self.blocksync_reactor = None
+        self.fast_sync = False
         if config.p2p.laddr:
             from tendermint_tpu.consensus.reactor import ConsensusReactor
             from tendermint_tpu.evidence.reactor import EvidenceReactor
@@ -172,15 +174,34 @@ class Node:
             )
             transport = MultiplexTransport(self.node_key, node_info)
             self.switch = Switch(transport)
-            self.consensus_reactor = ConsensusReactor(self.consensus)
+            # fast sync is pointless when we are the only validator
+            # (reference: node/node.go onlyValidatorIsUs)
+            only_us = (
+                priv_validator is not None
+                and state.validators.size() == 1
+                and state.validators.validators[0].address
+                == priv_validator.get_pub_key().address()
+            )
+            self.fast_sync = bool(config.base.fast_sync) and not only_us
+            self.consensus_reactor = ConsensusReactor(self.consensus, wait_sync=self.fast_sync)
             self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
             self.switch.add_reactor("MEMPOOL", MempoolReactor(self.mempool))
             self.switch.add_reactor("EVIDENCE", EvidenceReactor(self.evidence_pool))
+            from tendermint_tpu.blocksync.reactor import BlocksyncReactor
+
+            self.blocksync_reactor = BlocksyncReactor(
+                state, self.block_exec, self.block_store,
+                consensus_reactor=self.consensus_reactor, active=self.fast_sync,
+            )
+            self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
 
     async def start(self) -> None:
         self._running = True
         await self.indexer_service.start()
-        await self.consensus.start()
+        if not (self.switch is not None and self.fast_sync):
+            # with fast sync active, consensus starts at the blocksync handoff
+            # (reference: node/node.go:897 startStateSync -> SwitchToConsensus)
+            await self.consensus.start()
         if self.switch is not None:
             await self.switch.start()
             host, port = self._parse_laddr(self.config.p2p.laddr)

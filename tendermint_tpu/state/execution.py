@@ -75,7 +75,13 @@ class BlockExecutor:
 
     # -- validation (reference: state/validation.go:15) ---------------------
 
-    def validate_block(self, state: State, block: Block) -> None:
+    def validate_block(self, state: State, block: Block, trust_last_commit: bool = False) -> None:
+        """trust_last_commit=True skips the LastCommit signature check (all
+        structural checks still run) — used by fast sync, whose pool already
+        verified the same signatures in a cross-block device batch. The
+        reference re-verifies here (state/validation.go:15 after
+        VerifyCommitLight in the v0 reactor); skipping the duplicate work is a
+        deliberate improvement, safe because the batch covered +2/3 power."""
         block.validate_basic()
         h = block.header
         if h.version != state.version:
@@ -105,9 +111,14 @@ class BlockExecutor:
         else:
             if state.last_validators is None:
                 raise BlockValidationError("no last validators to verify commit")
-            state.last_validators.verify_commit(
-                state.chain_id, state.last_block_id, block.header.height - 1, block.last_commit
-            )
+            if not trust_last_commit:
+                state.last_validators.verify_commit(
+                    state.chain_id, state.last_block_id, block.header.height - 1, block.last_commit
+                )
+            elif block.last_commit.block_id != state.last_block_id or (
+                block.last_commit.height != block.header.height - 1
+            ):
+                raise BlockValidationError("wrong LastCommit block id/height")
 
         if not state.validators.has_address(h.proposer_address):
             raise BlockValidationError("block proposer is not in the validator set")
@@ -119,9 +130,11 @@ class BlockExecutor:
 
     # -- the apply pipeline -------------------------------------------------
 
-    def apply_block(self, state: State, block_id: BlockID, block: Block) -> State:
+    def apply_block(
+        self, state: State, block_id: BlockID, block: Block, trust_last_commit: bool = False
+    ) -> State:
         """(reference: state/execution.go:126 ApplyBlock)"""
-        self.validate_block(state, block)
+        self.validate_block(state, block, trust_last_commit=trust_last_commit)
 
         abci_responses = self._exec_block_on_proxy_app(state, block)
 
@@ -173,8 +186,21 @@ class BlockExecutor:
         )
         deliver_txs: List[abci.ResponseDeliverTx] = []
         invalid = 0
-        for tx in block.txs:
-            res = self.proxy_app.deliver_tx(abci.RequestDeliverTx(tx=tx))
+        deliver_async = getattr(self.proxy_app, "deliver_tx_async", None)
+        if deliver_async is not None and block.txs:
+            # pipelined delivery: queue every tx before waiting on responses,
+            # FIFO-matched by the socket client (reference:
+            # state/execution.go:308 DeliverTxAsync)
+            futures = [deliver_async(abci.RequestDeliverTx(tx=tx)) for tx in block.txs]
+            flush = getattr(self.proxy_app, "flush", None)
+            if flush is not None:
+                flush()
+            results = [f.result(timeout=60) for f in futures]
+        else:
+            results = [
+                self.proxy_app.deliver_tx(abci.RequestDeliverTx(tx=tx)) for tx in block.txs
+            ]
+        for res in results:
             if res.code != abci.CODE_TYPE_OK:
                 invalid += 1
             deliver_txs.append(res)
