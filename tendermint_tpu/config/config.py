@@ -185,3 +185,6 @@ def test_config() -> Config:
     cfg.consensus.skip_timeout_commit = True
     cfg.p2p.laddr = ""  # tests opt in to p2p with an explicit port
     return cfg
+
+
+test_config.__test__ = False  # not a pytest case when imported into test modules

@@ -132,12 +132,35 @@ def verify_batch(
     msgs: Sequence[bytes],
     sigs: Sequence[bytes],
     backend: str | None = None,
+    key_types: Sequence[str] | None = None,
 ) -> np.ndarray:
-    """Verify N (pubkey, msg, sig) ed25519 triples; returns bool[N]."""
+    """Verify N (pubkey, msg, sig) triples; returns bool[N].
+
+    key_types: per-row key type ("ed25519"/"sr25519"); None means all
+    ed25519. Mixed sets (BASELINE config 5) route ed25519 rows through the
+    selected backend (TPU batch on "jax") and sr25519 rows through the host
+    schnorrkel path."""
     if not (len(pubkeys) == len(msgs) == len(sigs)):
         raise ValueError("pubkeys/msgs/sigs length mismatch")
     if len(pubkeys) == 0:
         return np.zeros(0, dtype=bool)
+    if key_types is not None and any(t != "ed25519" for t in key_types):
+        from tendermint_tpu.crypto.sr25519 import sr25519_verify
+
+        out = np.zeros(len(pubkeys), dtype=bool)
+        ed_idx = [i for i, t in enumerate(key_types) if t == "ed25519"]
+        sr_idx = [i for i, t in enumerate(key_types) if t == "sr25519"]
+        if ed_idx:
+            sub = verify_batch(
+                [pubkeys[i] for i in ed_idx],
+                [msgs[i] for i in ed_idx],
+                [sigs[i] for i in ed_idx],
+                backend,
+            )
+            out[ed_idx] = sub
+        for i in sr_idx:
+            out[i] = sr25519_verify(bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i]))
+        return out
     be = backend or backend_default()
     if be == "cpu":
         return verify_batch_cpu(pubkeys, msgs, sigs)

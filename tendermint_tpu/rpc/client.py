@@ -1,0 +1,123 @@
+"""RPC clients (reference: rpc/client/http + rpc/client/local).
+
+HTTPClient speaks JSON-RPC over HTTP (aiohttp) to any node's RPC server;
+LocalClient calls the in-process server handlers directly (backs the light
+client's provider and tests without a socket, reference: rpc/client/local)."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import aiohttp
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(f"RPC error {code}: {message} {data}")
+        self.code = code
+
+
+class HTTPClient:
+    """(reference: rpc/client/http/http.go)"""
+
+    def __init__(self, base_url: str):
+        if not base_url.startswith("http"):
+            base_url = "http://" + base_url.replace("tcp://", "")
+        self.base_url = base_url.rstrip("/")
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._id = 0
+
+    async def _ensure(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+    async def call(self, method: str, **params):
+        session = await self._ensure()
+        self._id += 1
+        payload = {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
+        async with session.post(self.base_url + "/", json=payload) as resp:
+            body = await resp.json(content_type=None)
+        if body.get("error"):
+            err = body["error"]
+            raise RPCError(err.get("code", -1), err.get("message", ""), err.get("data", ""))
+        return body.get("result")
+
+    # convenience wrappers (the route set mirrors rpc/core/routes.go)
+    async def status(self):
+        return await self.call("status")
+
+    async def health(self):
+        return await self.call("health")
+
+    async def block(self, height: Optional[int] = None):
+        return await self.call("block", **({"height": height} if height else {}))
+
+    async def block_by_hash(self, block_hash: str):
+        return await self.call("block_by_hash", hash=block_hash)
+
+    async def block_results(self, height: Optional[int] = None):
+        return await self.call("block_results", **({"height": height} if height else {}))
+
+    async def commit(self, height: Optional[int] = None):
+        return await self.call("commit", **({"height": height} if height else {}))
+
+    async def validators(self, height: Optional[int] = None):
+        return await self.call("validators", **({"height": height} if height else {}))
+
+    async def genesis(self):
+        return await self.call("genesis")
+
+    async def tx(self, tx_hash: str):
+        return await self.call("tx", hash=tx_hash)
+
+    async def tx_search(self, query: str, page: int = 1, per_page: int = 30):
+        return await self.call("tx_search", query=query, page=page, per_page=per_page)
+
+    async def block_search(self, query: str, page: int = 1, per_page: int = 30):
+        return await self.call("block_search", query=query, page=page, per_page=per_page)
+
+    async def broadcast_tx_sync(self, tx: bytes):
+        return await self.call("broadcast_tx_sync", tx="0x" + tx.hex())
+
+    async def broadcast_tx_commit(self, tx: bytes):
+        return await self.call("broadcast_tx_commit", tx="0x" + tx.hex())
+
+    async def abci_query(self, path: str, data: bytes, height: int = 0, prove: bool = False):
+        return await self.call("abci_query", path=path, data=data.hex(), height=height, prove=prove)
+
+    async def net_info(self):
+        return await self.call("net_info")
+
+    async def consensus_state(self):
+        return await self.call("consensus_state")
+
+    async def dump_consensus_state(self):
+        return await self.call("dump_consensus_state")
+
+
+class LocalClient:
+    """Direct in-process calls against a node's RPC handler table
+    (reference: rpc/client/local/local.go)."""
+
+    def __init__(self, node):
+        from tendermint_tpu.rpc.server import RPCServer
+
+        self._server = RPCServer(node) if node.rpc_server is None else node.rpc_server
+
+    async def call(self, method: str, **params):
+        handler = self._server._routes.get(method)
+        if handler is None:
+            raise RPCError(-32601, f"method {method} not found")
+        return await handler(params)
+
+    def __getattr__(self, name):
+        async def _proxy(**params):
+            return await self.call(name, **params)
+
+        return _proxy

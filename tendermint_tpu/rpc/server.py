@@ -40,7 +40,7 @@ class RPCServer:
         addr = node.config.rpc.laddr.replace("tcp://", "")
         host, _, port = addr.rpartition(":")
         self.host = host or "127.0.0.1"
-        self.port = int(port)
+        self.port = int(port) if port else 0  # 0: handler-only (LocalClient)
         self.app = web.Application(client_max_size=node.config.rpc.max_body_bytes)
         self.app.router.add_post("/", self._handle_jsonrpc)
         self.app.router.add_get("/websocket", self._handle_websocket)
@@ -63,7 +63,15 @@ class RPCServer:
             "unconfirmed_txs": self._unconfirmed_txs,
             "num_unconfirmed_txs": self._num_unconfirmed_txs,
             "consensus_state": self._consensus_state,
+            "dump_consensus_state": self._dump_consensus_state,
+            "consensus_params": self._consensus_params,
             "net_info": self._net_info,
+            "tx_search": self._tx_search,
+            "block_search": self._block_search,
+            "block_results": self._block_results,
+            "block_by_hash": self._block_by_hash,
+            "broadcast_evidence": self._broadcast_evidence,
+            "dial_peers": self._dial_peers,
         }
 
     async def start(self) -> None:
@@ -445,5 +453,210 @@ class RPCServer:
     async def _consensus_state(self, params) -> dict:
         return {"round_state": self.node.consensus.rs.round_state_summary()}
 
+    async def _dump_consensus_state(self, params) -> dict:
+        """(reference: rpc/core/consensus.go DumpConsensusState)"""
+        rs = self.node.consensus.rs
+        votes = []
+        if rs.votes is not None:
+            for r in range(rs.round + 1):
+                pv, pc = rs.votes.prevotes(r), rs.votes.precommits(r)
+                votes.append(
+                    {
+                        "round": r,
+                        "prevotes": pv.bit_array() if pv else [],
+                        "prevotes_power": str(pv.sum_power()) if pv else "0",
+                        "precommits": pc.bit_array() if pc else [],
+                        "precommits_power": str(pc.sum_power()) if pc else "0",
+                    }
+                )
+        peers = []
+        if self.node.switch is not None:
+            for p in self.node.switch.peers.list():
+                ps = p.get("cs_peer_state")
+                peers.append(
+                    {
+                        "node_address": p.id,
+                        "peer_state": {
+                            "height": str(ps.height),
+                            "round": ps.round,
+                            "step": int(ps.step),
+                        }
+                        if ps
+                        else None,
+                    }
+                )
+        return {
+            "round_state": {
+                "height": str(rs.height),
+                "round": rs.round,
+                "step": int(rs.step),
+                "locked_round": rs.locked_round,
+                "valid_round": rs.valid_round,
+                "proposal": rs.proposal is not None,
+                "proposal_block": rs.proposal_block.hash().hex().upper() if rs.proposal_block else "",
+                "height_vote_set": votes,
+            },
+            "peers": peers,
+        }
+
+    async def _consensus_params(self, params) -> dict:
+        height = int(params.get("height") or (self.node.state.last_block_height + 1))
+        cp = self.node.state.consensus_params
+        return {
+            "block_height": str(height),
+            "consensus_params": {
+                "block": {"max_bytes": str(cp.block.max_bytes), "max_gas": str(cp.block.max_gas)},
+                "evidence": {
+                    "max_age_num_blocks": str(cp.evidence.max_age_num_blocks),
+                    "max_age_duration": str(cp.evidence.max_age_duration_ns),
+                },
+            },
+        }
+
+    async def _tx_search(self, params) -> dict:
+        """query like "tm.event.key='v'" or "app.creator='x'"; supports
+        key=value equality terms (reference: rpc/core/tx.go TxSearch over the
+        kv indexer state/txindex/kv/kv.go)."""
+        query = params.get("query", "")
+        terms = [t.strip() for t in query.split(" AND ") if t.strip()]
+        results = None
+        for term in terms:
+            if "=" not in term:
+                raise ValueError(f"bad query term {term!r}")
+            key, _, val = term.partition("=")
+            key = key.strip()
+            val = val.strip().strip("'\"")
+            if key == "tx.height":
+                found = self.node.tx_indexer.by_height(int(val))
+            else:
+                found = self.node.tx_indexer.search(key, val)
+            keys = {tmhash.sum256(r.tx) for r in found}
+            if results is None:
+                results = {tmhash.sum256(r.tx): r for r in found}
+            else:
+                results = {k: v for k, v in results.items() if k in keys}
+        results = list((results or {}).values())
+        page = int(params.get("page", 1))
+        per_page = min(int(params.get("per_page", 30)), 100)
+        start = (page - 1) * per_page
+        out = results[start : start + per_page]
+        return {
+            "txs": [
+                {
+                    "hash": tmhash.sum256(r.tx).hex().upper(),
+                    "height": str(r.height),
+                    "index": r.index,
+                    "tx_result": {"code": r.code, "data": _b64(r.data), "log": r.log},
+                    "tx": _b64(r.tx),
+                }
+                for r in out
+            ],
+            "total_count": str(len(results)),
+        }
+
+    async def _block_search(self, params) -> dict:
+        """Search blocks by height range terms, e.g.
+        "block.height > 5 AND block.height <= 10"
+        (reference: rpc/core/blocks.go BlockSearch)."""
+        query = params.get("query", "")
+        store = self.node.block_store
+        lo, hi = store.base, store.height
+        for term in (t.strip() for t in query.split(" AND ") if t.strip()):
+            for op in (">=", "<=", ">", "<", "="):
+                if op in term:
+                    key, _, val = term.partition(op)
+                    if key.strip() != "block.height":
+                        raise ValueError(f"unsupported block_search key {key.strip()!r}")
+                    v = int(val.strip().strip("'\""))
+                    if op == ">=":
+                        lo = max(lo, v)
+                    elif op == ">":
+                        lo = max(lo, v + 1)
+                    elif op == "<=":
+                        hi = min(hi, v)
+                    elif op == "<":
+                        hi = min(hi, v - 1)
+                    else:
+                        lo = hi = v
+                    break
+            else:
+                raise ValueError(f"bad query term {term!r}")
+        blocks = []
+        for h in range(lo, hi + 1):
+            block = store.load_block(h)
+            meta = store.load_block_meta(h)
+            if block is not None and meta is not None:
+                blocks.append(self._block_to_json(block, meta[0]))
+        page = int(params.get("page", 1))
+        per_page = min(int(params.get("per_page", 30)), 100)
+        start = (page - 1) * per_page
+        return {"blocks": blocks[start : start + per_page], "total_count": str(len(blocks))}
+
+    async def _block_results(self, params) -> dict:
+        height = int(params.get("height") or self.node.block_store.height)
+        resp = self.node.state_store.load_abci_responses(height)
+        if resp is None:
+            raise ValueError(f"no ABCI results for height {height}")
+        return {
+            "height": str(height),
+            "txs_results": [
+                {"code": r.code, "data": _b64(r.data), "log": r.log, "gas_used": str(r.gas_used)}
+                for r in resp.deliver_txs
+            ],
+            "validator_updates": [
+                {"pub_key": {"type": u.pub_key_type, "value": _b64(u.pub_key_bytes)}, "power": str(u.power)}
+                for u in (resp.end_block.validator_updates if resp.end_block else [])
+            ],
+        }
+
+    async def _block_by_hash(self, params) -> dict:
+        h = params.get("hash", "")
+        block_hash = bytes.fromhex(h[2:] if h.startswith("0x") else h) if isinstance(h, str) else bytes(h)
+        block = self.node.block_store.load_block_by_hash(block_hash)
+        if block is None:
+            raise ValueError(f"block {block_hash.hex()} not found")
+        meta = self.node.block_store.load_block_meta(block.header.height)
+        return self._block_to_json(block, meta[0])
+
+    async def _broadcast_evidence(self, params) -> dict:
+        """(reference: rpc/core/evidence.go)"""
+        from tendermint_tpu.types.evidence import decode_evidence
+
+        raw = params.get("evidence", "")
+        data = bytes.fromhex(raw[2:] if raw.startswith("0x") else raw) if isinstance(raw, str) else bytes(raw)
+        ev = decode_evidence(data)
+        self.node.evidence_pool.add_evidence(ev)
+        return {"hash": ev.hash().hex().upper()}
+
+    async def _dial_peers(self, params) -> dict:
+        """unsafe route (reference: rpc/core/net.go UnsafeDialPeers)."""
+        if self.node.switch is None:
+            raise ValueError("p2p is not enabled")
+        peers = params.get("peers", [])
+        if isinstance(peers, str):
+            peers = [p for p in peers.split(",") if p]
+        persistent = bool(params.get("persistent", False))
+        await self.node.switch.dial_peers_async(peers, persistent=persistent)
+        return {"log": f"dialing {len(peers)} peers"}
+
     async def _net_info(self, params) -> dict:
-        return {"listening": False, "listeners": [], "n_peers": "0", "peers": []}
+        sw = self.node.switch
+        if sw is None:
+            return {"listening": False, "listeners": [], "n_peers": "0", "peers": []}
+        return {
+            "listening": True,
+            "listeners": [sw.transport.listen_addr],
+            "n_peers": str(sw.num_peers()),
+            "peers": [
+                {
+                    "node_info": {
+                        "id": p.id,
+                        "moniker": p.node_info.moniker,
+                        "network": p.node_info.network,
+                    },
+                    "is_outbound": p.outbound,
+                    "remote_ip": p.socket_addr,
+                }
+                for p in sw.peers.list()
+            ],
+        }

@@ -332,7 +332,7 @@ class ValidatorSet:
             raise CommitVerifyError(
                 f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
             )
-        pubkeys, msgs, sigs, meta = [], [], [], []
+        pubkeys, msgs, sigs, meta, key_types = [], [], [], [], []
         for idx, cs in enumerate(commit.signatures):
             if cs.absent():
                 continue
@@ -341,7 +341,8 @@ class ValidatorSet:
             msgs.append(commit.vote_sign_bytes(chain_id, idx))
             sigs.append(cs.signature)
             meta.append((idx, val.voting_power, cs.for_block()))
-        mask = verify_batch(pubkeys, msgs, sigs)
+            key_types.append(val.pub_key.type_name())
+        mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
         tallied = 0
         for ok, (idx, power, for_block) in zip(mask, meta):
             if not ok:
@@ -366,6 +367,7 @@ class ValidatorSet:
                 f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
             )
         pubkeys, msgs, sigs, powers = [], [], [], []
+        key_types = []
         for idx, cs in enumerate(commit.signatures):
             if not cs.for_block():
                 continue
@@ -374,7 +376,8 @@ class ValidatorSet:
             msgs.append(commit.vote_sign_bytes(chain_id, idx))
             sigs.append(cs.signature)
             powers.append(val.voting_power)
-        mask = verify_batch(pubkeys, msgs, sigs)
+            key_types.append(val.pub_key.type_name())
+        mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
         tallied = sum(p for ok, p in zip(mask, powers) if ok)
         needed = self.total_voting_power() * 2 // 3
         if tallied <= needed:
@@ -391,6 +394,7 @@ class ValidatorSet:
         needed = total_mul // trust_level.denominator
         seen: Dict[int, int] = {}
         pubkeys, msgs, sigs, powers = [], [], [], []
+        key_types = []
         for idx, cs in enumerate(commit.signatures):
             if not cs.for_block():
                 continue
@@ -406,7 +410,8 @@ class ValidatorSet:
             msgs.append(commit.vote_sign_bytes(chain_id, idx))
             sigs.append(cs.signature)
             powers.append(val.voting_power)
-        mask = verify_batch(pubkeys, msgs, sigs)
+            key_types.append(val.pub_key.type_name())
+        mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
         tallied = sum(p for ok, p in zip(mask, powers) if ok)
         if tallied <= needed:
             raise NotEnoughVotingPowerError(tallied, needed)

@@ -1,0 +1,149 @@
+"""RPC server routes + HTTP/local clients
+(reference models: rpc/core tests, rpc/client tests)."""
+
+import asyncio
+import os
+
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.config.config import test_config
+from tendermint_tpu.crypto import gen_ed25519, tmhash
+from tendermint_tpu.node.node import Node
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.rpc.client import HTTPClient, LocalClient
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+
+def make_node(tmp_path, rpc_port=0):
+    cfg = test_config()
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}" if rpc_port else ""
+    cfg.root_dir = ""
+    cfg.consensus.wal_path = str(tmp_path / "wal")
+    priv = FilePV(gen_ed25519(b"\x81" * 32))
+    gen = GenesisDoc(chain_id="rpc-chain", validators=[GenesisValidator(priv.get_pub_key(), 10)])
+    return Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
+
+
+def test_rpc_routes_via_local_client(tmp_path):
+    async def run():
+        node = make_node(tmp_path)
+        await node.start()
+        try:
+            client = LocalClient(node)
+            # commit a tx and wait for it
+            res = await client.broadcast_tx_commit(tx="0x" + b"rpc=local".hex())
+            assert res["deliver_tx"]["code"] == 0
+            height = int(res["height"])
+
+            # tx + tx_search by height and by app event
+            h = tmhash.sum256(b"rpc=local").hex()
+            tx = await client.tx(hash=h)
+            assert int(tx["height"]) == height
+            found = await client.tx_search(query=f"tx.height={height}")
+            assert int(found["total_count"]) >= 1
+
+            # block_search over a range
+            await node.wait_for_height(height + 1, timeout=30)
+            bs = await client.block_search(query=f"block.height >= {height} AND block.height <= {height}")
+            assert int(bs["total_count"]) == 1
+            assert bs["blocks"][0]["block"]["header"]["height"] == str(height)
+
+            # block_results carries the deliver_tx result
+            br = await client.block_results(height=height)
+            assert br["txs_results"][0]["code"] == 0
+
+            # block_by_hash round-trips
+            blk = await client.block(height=height)
+            byh = await client.block_by_hash(hash=blk["block_id"]["hash"])
+            assert byh["block"]["header"]["height"] == str(height)
+
+            # consensus introspection
+            dcs = await client.dump_consensus_state()
+            assert int(dcs["round_state"]["height"]) >= height
+            cp = await client.consensus_params()
+            assert int(cp["consensus_params"]["block"]["max_bytes"]) > 0
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+
+def test_rpc_http_client_end_to_end(tmp_path):
+    async def run():
+        node = make_node(tmp_path, rpc_port=0)
+        # pick a free port
+        import socket as s
+
+        sock = s.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        node.config.rpc.laddr = f"tcp://127.0.0.1:{port}"
+        await node.start()
+        client = HTTPClient(f"http://127.0.0.1:{port}")
+        try:
+            st = await client.status()
+            assert st["node_info"]["network"] == "rpc-chain"
+            res = await client.broadcast_tx_commit(b"rpc=http")
+            assert res["deliver_tx"]["code"] == 0
+            q = await client.abci_query("/store", b"rpc")
+            import base64
+
+            assert base64.b64decode(q["response"]["value"]) == b"http"
+            ni = await client.net_info()
+            assert ni["n_peers"] == "0"
+            # error surfaces as RPCError
+            try:
+                await client.call("nonexistent_route")
+                assert False
+            except Exception as e:
+                assert "not found" in str(e)
+        finally:
+            await client.close()
+            await node.stop()
+
+    asyncio.run(run())
+
+
+def test_broadcast_evidence_route(tmp_path):
+    async def run():
+        node = make_node(tmp_path)
+        await node.start()
+        try:
+            import dataclasses
+            import time
+
+            from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+            from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+            from tendermint_tpu.types.vote import Vote
+
+            await node.wait_for_height(1, timeout=30)
+            priv = node.priv_validator
+            addr = priv.get_pub_key().address()
+            psh = PartSetHeader(total=1, hash=b"\x41" * 32)
+
+            def mkvote(bid):
+                v = Vote(
+                    type=SignedMsgType.PREVOTE, height=node.consensus.rs.height, round=0,
+                    block_id=bid, timestamp_ns=time.time_ns(),
+                    validator_address=addr, validator_index=0,
+                )
+                sig = priv.priv_key.sign(v.sign_bytes("rpc-chain"))
+                return dataclasses.replace(v, signature=sig)
+
+            va = mkvote(BlockID(b"\x42" * 32, psh))
+            vb = mkvote(BlockID(b"\x43" * 32, psh))
+            ev = DuplicateVoteEvidence.from_votes(
+                va, vb, time.time_ns(),
+                node.state.validators.total_voting_power(), 10,
+            )
+            client = LocalClient(node)
+            out = await client.broadcast_evidence(evidence="0x" + ev.encode().hex())
+            assert out["hash"] == ev.hash().hex().upper()
+            assert len(node.evidence_pool.pending_evidence(-1)) == 1
+        finally:
+            await node.stop()
+
+    asyncio.run(run())

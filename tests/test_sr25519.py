@@ -1,0 +1,131 @@
+"""sr25519 / ristretto255 / merlin tests.
+
+Spec conformance: ristretto255 small-multiples test vectors (public
+ristretto255 spec appendix) and the merlin transcript test vector (merlin
+crate's transcript test) pin the from-scratch implementations to the public
+specifications; the rest is behavioral."""
+
+import numpy as np
+
+from tendermint_tpu.crypto import batch as cbatch
+from tendermint_tpu.crypto.ed25519_ref import BASE, IDENTITY, point_add, point_mul
+from tendermint_tpu.crypto.merlin import Transcript
+from tendermint_tpu.crypto.sr25519 import (
+    Sr25519PubKey,
+    gen_sr25519,
+    ristretto_decode,
+    ristretto_encode,
+)
+
+# ristretto255 spec: encodings of B*0 .. B*4
+SMALL_MULTIPLES = [
+    "0000000000000000000000000000000000000000000000000000000000000000",
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+    "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+]
+
+
+def test_ristretto_small_multiples_match_spec():
+    pt = IDENTITY
+    for i, want in enumerate(SMALL_MULTIPLES):
+        assert ristretto_encode(pt).hex() == want, f"B*{i}"
+        pt = point_add(pt, BASE)
+
+
+def test_ristretto_decode_encode_roundtrip():
+    for i in range(1, 16):
+        pt = point_mul(i, BASE)
+        enc = ristretto_encode(pt)
+        dec = ristretto_decode(enc)
+        assert dec is not None
+        assert ristretto_encode(dec) == enc
+
+
+def test_ristretto_rejects_invalid():
+    # non-canonical (>= p)
+    from tendermint_tpu.crypto.ed25519_ref import P
+
+    assert ristretto_decode(int.to_bytes(P + 1, 32, "little")) is None
+    # negative encoding (odd)
+    assert ristretto_decode(int.to_bytes(1, 32, "little")) is None
+
+
+def test_merlin_transcript_vector():
+    """merlin crate test_transcript_it_works equivalence."""
+    t = Transcript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    cb = t.challenge_bytes(b"challenge", 32)
+    assert cb.hex() == "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+
+
+def test_sr25519_sign_verify_roundtrip():
+    priv = gen_sr25519(b"\x01" * 32)
+    pub = priv.pub_key()
+    msg = b"vote sign bytes"
+    sig = priv.sign(msg)
+    assert len(sig) == 64
+    assert sig[63] & 0x80  # schnorrkel marker bit
+    assert pub.verify(msg, sig)
+    # tamper: message, signature, wrong key
+    assert not pub.verify(b"other message", sig)
+    bad = bytearray(sig)
+    bad[1] ^= 1
+    assert not pub.verify(msg, bytes(bad))
+    assert not gen_sr25519(b"\x02" * 32).pub_key().verify(msg, sig)
+
+
+def test_sr25519_rejects_missing_marker_and_high_s():
+    priv = gen_sr25519(b"\x03" * 32)
+    msg = b"m"
+    sig = bytearray(priv.sign(msg))
+    sig[63] &= 0x7F  # clear marker
+    assert not priv.pub_key().verify(msg, bytes(sig))
+
+
+def test_mixed_batch_routes_by_key_type():
+    from tendermint_tpu.crypto.keys import gen_ed25519
+
+    ed = gen_ed25519(b"\x04" * 32)
+    sr = gen_sr25519(b"\x05" * 32)
+    msgs = [b"m0", b"m1", b"m2", b"m3"]
+    pubkeys = [ed.pub_key().bytes(), sr.pub_key().bytes(), ed.pub_key().bytes(), sr.pub_key().bytes()]
+    sigs = [ed.sign(msgs[0]), sr.sign(msgs[1]), ed.sign(b"WRONG"), sr.sign(b"WRONG")]
+    types = ["ed25519", "sr25519", "ed25519", "sr25519"]
+    mask = cbatch.verify_batch(pubkeys, msgs, sigs, backend="cpu", key_types=types)
+    assert mask.tolist() == [True, True, False, False]
+
+
+def test_mixed_validator_set_commit():
+    """A commit from a mixed ed25519+sr25519 validator set verifies
+    (BASELINE config 5 shape, small)."""
+    import time
+
+    from tendermint_tpu.crypto.keys import gen_ed25519
+    from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    privs = [gen_ed25519(bytes([i]) * 32) if i % 2 == 0 else gen_sr25519(bytes([i]) * 32) for i in range(1, 7)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    bid = BlockID(b"\x10" * 32, PartSetHeader(total=1, hash=b"\x11" * 32))
+    vs = VoteSet("mixed-chain", 5, 0, SignedMsgType.PRECOMMIT, vals)
+    import dataclasses
+
+    for p in privs:
+        addr = p.pub_key().address()
+        idx, _ = vals.get_by_address(addr)
+        v = Vote(
+            type=SignedMsgType.PRECOMMIT, height=5, round=0, block_id=bid,
+            timestamp_ns=time.time_ns(), validator_address=addr, validator_index=idx,
+        )
+        sig = p.sign(v.sign_bytes("mixed-chain"))
+        assert vs.add_vote(dataclasses.replace(v, signature=sig))
+    commit = vs.make_commit()
+    vals.verify_commit("mixed-chain", bid, 5, commit)  # must not raise
+    vals.verify_commit_light("mixed-chain", bid, 5, commit)
+    from tendermint_tpu.types.validator_set import Fraction
+
+    vals.verify_commit_light_trusting("mixed-chain", commit, Fraction(1, 3))
