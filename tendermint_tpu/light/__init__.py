@@ -1,0 +1,33 @@
+"""Light client: trust-minimized header verification.
+
+reference: light/ — client.go, verifier.go, store/, provider/, detector.go.
+"""
+
+from tendermint_tpu.light.client import (  # noqa: F401
+    Client,
+    ErrConflictingHeaders,
+    ErrNoWitnesses,
+    SEQUENTIAL,
+    SKIPPING,
+    TrustOptions,
+)
+from tendermint_tpu.light.provider import (  # noqa: F401
+    ErrBadLightBlock,
+    ErrLightBlockNotFound,
+    ErrNoResponse,
+    HTTPProvider,
+    MockProvider,
+    Provider,
+)
+from tendermint_tpu.light.store import LightStore  # noqa: F401
+from tendermint_tpu.light.verifier import (  # noqa: F401
+    DEFAULT_TRUST_LEVEL,
+    ErrInvalidHeader,
+    ErrNewValSetCantBeTrusted,
+    ErrOldHeaderExpired,
+    LightError,
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)

@@ -1,0 +1,86 @@
+"""Trusted light-block store.
+
+reference: light/store/store.go (Store iface) + light/store/db/db.go
+(DB-backed impl with ordered heights, size-bounded pruning).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+from tendermint_tpu.libs.kvdb import KVDB
+from tendermint_tpu.types.light import (
+    LightBlock,
+    light_block_from_bytes,
+    light_block_to_bytes,
+)
+
+_LB_PREFIX = b"lb/"
+
+
+def _key(height: int) -> bytes:
+    return _LB_PREFIX + struct.pack(">Q", height)
+
+
+class LightStore:
+    """Stores verified light blocks keyed by big-endian height so prefix
+    iteration yields ascending order (reference: light/store/db/db.go:33)."""
+
+    def __init__(self, db: KVDB):
+        self.db = db
+        self._heights: List[int] = [
+            struct.unpack(">Q", k[len(_LB_PREFIX):])[0]
+            for k, _ in db.iterate_prefix(_LB_PREFIX)
+        ]
+        self._heights.sort()
+
+    def save_light_block(self, lb: LightBlock) -> None:
+        """reference: light/store/db/db.go:52 SaveLightBlock."""
+        if lb.height <= 0:
+            raise ValueError("height <= 0")
+        if lb.height not in self._heights:
+            import bisect
+
+            bisect.insort(self._heights, lb.height)
+        self.db.set(_key(lb.height), light_block_to_bytes(lb))
+
+    def light_block(self, height: int) -> Optional[LightBlock]:
+        """reference: light/store/db/db.go:96 LightBlock."""
+        raw = self.db.get(_key(height))
+        return light_block_from_bytes(raw) if raw is not None else None
+
+    def latest_light_block(self) -> Optional[LightBlock]:
+        """reference: light/store/db/db.go:126 LightBlockBefore/latest."""
+        return self.light_block(self._heights[-1]) if self._heights else None
+
+    def first_light_block(self) -> Optional[LightBlock]:
+        return self.light_block(self._heights[0]) if self._heights else None
+
+    def light_block_before(self, height: int) -> Optional[LightBlock]:
+        """Latest stored block strictly below height
+        (reference: light/store/db/db.go:126)."""
+        import bisect
+
+        i = bisect.bisect_left(self._heights, height)
+        if i == 0:
+            return None
+        return self.light_block(self._heights[i - 1])
+
+    def delete_light_block(self, height: int) -> None:
+        self.db.delete(_key(height))
+        try:
+            self._heights.remove(height)
+        except ValueError:
+            pass
+
+    def prune(self, size: int) -> None:
+        """Keep only the newest `size` blocks (reference: light/store/db/db.go:152)."""
+        while len(self._heights) > size:
+            self.delete_light_block(self._heights[0])
+
+    def size(self) -> int:
+        return len(self._heights)
+
+    def heights(self) -> List[int]:
+        return list(self._heights)

@@ -16,6 +16,12 @@ from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto import tmhash
 from tendermint_tpu.libs.pubsub import Query
 from tendermint_tpu.types.event_bus import EVENT_TX, TX_HASH_KEY, query_for_event
+from tendermint_tpu.types.light import (
+    block_id_to_json,
+    commit_to_json,
+    header_to_json,
+    validator_to_json,
+)
 
 logger = logging.getLogger("tendermint_tpu.rpc")
 
@@ -312,30 +318,11 @@ class RPCServer:
 
     def _block_to_json(self, block, block_id) -> dict:
         return {
-            "block_id": {
-                "hash": block_id.hash.hex().upper(),
-                "parts": {
-                    "total": block_id.part_set_header.total,
-                    "hash": block_id.part_set_header.hash.hex().upper(),
-                },
-            },
+            "block_id": block_id_to_json(block_id),
             "block": {
-                "header": {
-                    "chain_id": block.header.chain_id,
-                    "height": str(block.header.height),
-                    "time_ns": str(block.header.time_ns),
-                    "last_block_id": {"hash": block.header.last_block_id.hash.hex().upper()},
-                    "app_hash": block.header.app_hash.hex().upper(),
-                    "data_hash": block.header.data_hash.hex().upper(),
-                    "validators_hash": block.header.validators_hash.hex().upper(),
-                    "proposer_address": block.header.proposer_address.hex().upper(),
-                },
+                "header": header_to_json(block.header),
                 "data": {"txs": [_b64(tx) for tx in block.txs]},
-                "last_commit": {
-                    "height": str(block.last_commit.height),
-                    "round": block.last_commit.round,
-                    "signatures": len(block.last_commit.signatures),
-                },
+                "last_commit": commit_to_json(block.last_commit),
             },
         }
 
@@ -367,30 +354,28 @@ class RPCServer:
         return {"last_height": str(store.height), "block_metas": metas}
 
     async def _commit(self, params) -> dict:
+        """Full signed header — backs the light client's HTTPProvider
+        (reference: rpc/core/blocks.go Commit). canonical=True when the commit
+        comes from the next block's LastCommit, else the seen commit."""
         height = int(params.get("height") or self.node.block_store.height)
-        commit = self.node.block_store.load_seen_commit(height)
         block = self.node.block_store.load_block(height)
-        if commit is None or block is None:
+        if block is None:
+            raise ValueError(f"block at height {height} not found")
+        canonical = False
+        commit = None
+        nxt = self.node.block_store.load_block(height + 1)
+        if nxt is not None and nxt.last_commit.height == height:
+            commit, canonical = nxt.last_commit, True
+        else:
+            commit = self.node.block_store.load_seen_commit(height)
+        if commit is None:
             raise ValueError(f"commit at height {height} not found")
         return {
             "signed_header": {
-                "header": {"height": str(height), "chain_id": block.header.chain_id,
-                           "app_hash": block.header.app_hash.hex().upper()},
-                "commit": {
-                    "height": str(commit.height),
-                    "round": commit.round,
-                    "block_id": {"hash": commit.block_id.hash.hex().upper()},
-                    "signatures": [
-                        {
-                            "block_id_flag": int(cs.block_id_flag),
-                            "validator_address": cs.validator_address.hex().upper(),
-                            "signature": _b64(cs.signature),
-                        }
-                        for cs in commit.signatures
-                    ],
-                },
+                "header": header_to_json(block.header),
+                "commit": commit_to_json(commit),
             },
-            "canonical": True,
+            "canonical": canonical,
         }
 
     async def _validators(self, params) -> dict:
@@ -400,15 +385,7 @@ class RPCServer:
             raise ValueError(f"no validator set at height {height}")
         return {
             "block_height": str(height),
-            "validators": [
-                {
-                    "address": v.address.hex().upper(),
-                    "pub_key": {"type": v.pub_key.type_name(), "value": _b64(v.pub_key.bytes())},
-                    "voting_power": str(v.voting_power),
-                    "proposer_priority": str(v.proposer_priority),
-                }
-                for v in vals.validators
-            ],
+            "validators": [validator_to_json(v) for v in vals.validators],
             "count": str(len(vals.validators)),
             "total": str(len(vals.validators)),
         }

@@ -1,0 +1,300 @@
+"""Light client tests: sequential + bisection verification, witness
+divergence, backwards verify, store pruning
+(reference test model: light/client_test.go, light/verifier_test.go)."""
+
+import asyncio
+import os
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.crypto.keys import gen_ed25519
+from tendermint_tpu.light import (
+    Client,
+    ErrConflictingHeaders,
+    ErrOldHeaderExpired,
+    LightStore,
+    MockProvider,
+    SEQUENTIAL,
+    SKIPPING,
+    TrustOptions,
+)
+from tendermint_tpu.libs.kvdb import MemDB
+from tendermint_tpu.types.basic import NANOS, BlockID, BlockIDFlag, PartSetHeader
+from tendermint_tpu.types.block import Commit, CommitSig, ConsensusVersion, Header
+from tendermint_tpu.types.light import (
+    LightBlock,
+    SignedHeader,
+    light_block_from_bytes,
+    light_block_to_bytes,
+)
+from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+
+CHAIN_ID = "light-chain"
+T0 = 1_700_000_000 * NANOS  # genesis time
+BLOCK_NS = 1 * NANOS  # one block per second
+
+
+def make_keys(tag: bytes, n: int):
+    return [gen_ed25519(bytes([i]) + tag * 31) for i in range(n)]
+
+
+def valset_of(privs):
+    return ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+
+
+def sign_commit(header: Header, valset: ValidatorSet, privs) -> Commit:
+    """Every validator signs a precommit for the header."""
+    block_id = BlockID(header.hash(), PartSetHeader(1, tmhash.sum256(header.hash())))
+    ts = header.time_ns
+    by_addr = {p.pub_key().address(): p for p in privs}
+    placeholder = [
+        CommitSig(BlockIDFlag.COMMIT, v.address, ts, b"\x00" * 64)
+        for v in valset.validators
+    ]
+    commit = Commit(header.height, 0, block_id, placeholder)
+    sigs = []
+    for idx, v in enumerate(valset.validators):
+        sb = commit.vote_sign_bytes(CHAIN_ID, idx)
+        sigs.append(CommitSig(BlockIDFlag.COMMIT, v.address, ts, by_addr[v.address].sign(sb)))
+    return Commit(header.height, 0, block_id, sigs)
+
+
+def make_chain(n: int, privs_by_height=None, default_privs=None):
+    """n light blocks with correct validators/next-validators hash chaining.
+
+    privs_by_height: {height: [privkeys]} — valset changes take effect AT the
+    listed height (and the prior header's next_validators_hash reflects it).
+    """
+    default_privs = default_privs or make_keys(b"\x01", 4)
+
+    def privs_at(h):
+        if privs_by_height:
+            best = default_privs
+            for hh in sorted(privs_by_height):
+                if hh <= h:
+                    best = privs_by_height[hh]
+            return best
+        return default_privs
+
+    blocks = {}
+    prev_hash = b""
+    for h in range(1, n + 1):
+        vals = valset_of(privs_at(h))
+        next_vals = valset_of(privs_at(h + 1))
+        header = Header(
+            version=ConsensusVersion(),
+            chain_id=CHAIN_ID,
+            height=h,
+            time_ns=T0 + h * BLOCK_NS,
+            last_block_id=(
+                BlockID(prev_hash, PartSetHeader(1, tmhash.sum256(prev_hash)))
+                if prev_hash
+                else BlockID()
+            ),
+            last_commit_hash=tmhash.sum256(b"lc%d" % h),
+            data_hash=tmhash.sum256(b"d%d" % h),
+            validators_hash=vals.hash(),
+            next_validators_hash=next_vals.hash(),
+            consensus_hash=tmhash.sum256(b"c"),
+            app_hash=tmhash.sum256(b"a%d" % h),
+            last_results_hash=tmhash.sum256(b"r%d" % h),
+            evidence_hash=tmhash.sum256(b"e"),
+            proposer_address=vals.get_proposer().address,
+        )
+        commit = sign_commit(header, vals, privs_at(h))
+        blocks[h] = LightBlock(SignedHeader(header, commit), vals)
+        prev_hash = header.hash()
+    return blocks
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+NOW = T0 + 3600 * NANOS
+PERIOD = 24 * 3600 * NANOS
+
+
+def new_client(blocks, mode=SKIPPING, witnesses=None, trust_height=1, store=None):
+    primary = MockProvider(CHAIN_ID, blocks)
+    client = Client(
+        CHAIN_ID,
+        TrustOptions(PERIOD, trust_height, blocks[trust_height].hash()),
+        primary,
+        witnesses if witnesses is not None else [],
+        store or LightStore(MemDB()),
+        verification_mode=mode,
+    )
+    return client, primary
+
+
+def test_sequential_verification():
+    blocks = make_chain(10)
+    client, primary = new_client(blocks, mode=SEQUENTIAL)
+
+    async def go():
+        await client.initialize(NOW)
+        lb = await client.verify_light_block_at_height(10, NOW)
+        assert lb.hash() == blocks[10].hash()
+        # sequential stores every intermediate height
+        assert client.store.size() == 10
+
+    run(go())
+
+
+def test_skipping_single_jump_constant_valset():
+    blocks = make_chain(20)
+    client, primary = new_client(blocks, mode=SKIPPING)
+
+    async def go():
+        await client.initialize(NOW)
+        calls_before = primary.calls
+        lb = await client.verify_light_block_at_height(20, NOW)
+        assert lb.hash() == blocks[20].hash()
+        # constant valset: one fetch for the target, no interim fetches
+        assert primary.calls - calls_before == 1
+        assert client.store.heights() == [1, 20]
+
+    run(go())
+
+
+def test_skipping_bisects_across_full_valset_rotation():
+    old = make_keys(b"\x01", 4)
+    new = make_keys(b"\x02", 4)  # disjoint — zero overlap with old set
+    blocks = make_chain(20, privs_by_height={10: new}, default_privs=old)
+    client, _ = new_client(blocks, mode=SKIPPING)
+
+    async def go():
+        await client.initialize(NOW)
+        lb = await client.verify_light_block_at_height(20, NOW)
+        assert lb.hash() == blocks[20].hash()
+        # bisection had to cross the rotation boundary via interim headers
+        assert client.store.size() > 2
+
+    run(go())
+
+
+def test_expired_trust_root_rejected():
+    blocks = make_chain(5)
+    client, _ = new_client(blocks)
+
+    async def go():
+        late = T0 + PERIOD + 10 * NANOS
+        with pytest.raises(ErrOldHeaderExpired):
+            await client.initialize(late)
+
+    run(go())
+
+
+def test_witness_divergence_detected():
+    blocks = make_chain(10)
+    forged = make_chain(10, default_privs=make_keys(b"\x07", 4))
+    witness = MockProvider(CHAIN_ID, {**blocks, 8: forged[8]})
+    client, _ = new_client(blocks, witnesses=[witness])
+
+    async def go():
+        await client.initialize(NOW)
+        with pytest.raises(ErrConflictingHeaders):
+            await client.verify_light_block_at_height(8, NOW)
+        # conflicting witness removed
+        assert client.witnesses == []
+
+    run(go())
+
+
+def test_backwards_verification():
+    blocks = make_chain(10)
+    client, _ = new_client(blocks, trust_height=8)
+
+    async def go():
+        await client.initialize(NOW)
+        lb = await client.verify_light_block_at_height(3, NOW)
+        assert lb.hash() == blocks[3].hash()
+
+    run(go())
+
+
+def test_primary_failover_to_witness():
+    blocks = make_chain(6)
+    bad_primary = MockProvider(CHAIN_ID, {1: blocks[1]})  # has only the root
+    witness = MockProvider(CHAIN_ID, blocks)
+    client = Client(
+        CHAIN_ID,
+        TrustOptions(PERIOD, 1, blocks[1].hash()),
+        bad_primary,
+        [witness],
+        LightStore(MemDB()),
+    )
+
+    async def go():
+        await client.initialize(NOW)
+        lb = await client.verify_light_block_at_height(6, NOW)
+        assert lb.hash() == blocks[6].hash()
+        assert client.primary is witness
+
+    run(go())
+
+
+def test_store_prune_and_roundtrip():
+    blocks = make_chain(8)
+    store = LightStore(MemDB())
+    for lb in blocks.values():
+        store.save_light_block(lb)
+    assert store.size() == 8
+    store.prune(3)
+    assert store.heights() == [6, 7, 8]
+    assert store.first_light_block().height == 6
+    assert store.light_block_before(7).height == 6
+
+    lb = blocks[5]
+    rt = light_block_from_bytes(light_block_to_bytes(lb))
+    assert rt.hash() == lb.hash()
+    assert rt.validator_set.hash() == lb.validator_set.hash()
+    rt.validate_basic(CHAIN_ID)
+
+
+def test_light_client_tracks_live_node(tmp_path):
+    """HTTPProvider + light client against a real node over local RPC
+    (reference model: light/client_test.go + rpc/client integration)."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.light import HTTPProvider
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.rpc.client import LocalClient
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    async def go():
+        cfg = test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = ""
+        cfg.root_dir = ""
+        cfg.consensus.wal_path = str(tmp_path / "wal")
+        priv = FilePV(gen_ed25519(b"\x91" * 32))
+        gen = GenesisDoc(
+            chain_id="light-live", validators=[GenesisValidator(priv.get_pub_key(), 10)]
+        )
+        node = Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
+        await node.start()
+        try:
+            await node.wait_for_height(5, timeout=60)
+            provider = HTTPProvider("light-live", LocalClient(node))
+            root = await provider.light_block(2)
+            client = Client(
+                "light-live",
+                TrustOptions(PERIOD, 2, root.hash()),
+                provider,
+                [],
+                LightStore(MemDB()),
+            )
+            await client.initialize()
+            lb = await client.verify_light_block_at_height(5)
+            assert lb.height == 5
+            assert lb.hash() == node.block_store.load_block(5).hash()
+        finally:
+            await node.stop()
+
+    run(go())
