@@ -125,6 +125,15 @@ class BlocksyncReactor(Reactor):
         elif isinstance(msg, NoBlockResponse):
             logger.debug("peer %s has no block %d", peer.id[:10], msg.height)
 
+    async def switch_to_blocksync(self, state) -> None:
+        """Post-state-sync handoff: start syncing blocks from the restored
+        height (reference: blockchain/v0/reactor.go:116 SwitchToFastSync)."""
+        self.state = state
+        self.active = True
+        self._started_at = time.monotonic()
+        await self.start()
+        await self.switch.broadcast(BLOCKSYNC_CHANNEL, encode_message(StatusRequest()))
+
     # -- sync --------------------------------------------------------------
 
     async def _status_routine(self) -> None:

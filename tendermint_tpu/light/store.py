@@ -6,6 +6,7 @@ reference: light/store/store.go (Store iface) + light/store/db/db.go
 
 from __future__ import annotations
 
+import bisect
 import struct
 from typing import List, Optional
 
@@ -39,10 +40,9 @@ class LightStore:
         """reference: light/store/db/db.go:52 SaveLightBlock."""
         if lb.height <= 0:
             raise ValueError("height <= 0")
-        if lb.height not in self._heights:
-            import bisect
-
-            bisect.insort(self._heights, lb.height)
+        i = bisect.bisect_left(self._heights, lb.height)
+        if i == len(self._heights) or self._heights[i] != lb.height:
+            self._heights.insert(i, lb.height)
         self.db.set(_key(lb.height), light_block_to_bytes(lb))
 
     def light_block(self, height: int) -> Optional[LightBlock]:
@@ -60,8 +60,6 @@ class LightStore:
     def light_block_before(self, height: int) -> Optional[LightBlock]:
         """Latest stored block strictly below height
         (reference: light/store/db/db.go:126)."""
-        import bisect
-
         i = bisect.bisect_left(self._heights, height)
         if i == 0:
             return None

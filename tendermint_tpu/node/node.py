@@ -61,6 +61,7 @@ class Node:
         priv_validator: Optional[FilePV] = None,
         app=None,
         client_creator=None,
+        state_provider=None,
     ):
         self.config = config
         self.genesis = genesis
@@ -148,7 +149,13 @@ class Node:
         self.node_key = None
         self.consensus_reactor = None
         self.blocksync_reactor = None
+        self.statesync_reactor = None
         self.fast_sync = False
+        # state sync only makes sense on an empty chain
+        # (reference: node/node.go:672 decide stateSync)
+        self.state_sync = bool(config.statesync.enable) and self.block_store.height == 0
+        self._state_provider = state_provider
+        self._statesync_task = None
         if config.p2p.laddr:
             from tendermint_tpu.consensus.reactor import ConsensusReactor
             from tendermint_tpu.evidence.reactor import EvidenceReactor
@@ -183,24 +190,37 @@ class Node:
                 == priv_validator.get_pub_key().address()
             )
             self.fast_sync = bool(config.base.fast_sync) and not only_us
-            self.consensus_reactor = ConsensusReactor(self.consensus, wait_sync=self.fast_sync)
+            self.consensus_reactor = ConsensusReactor(
+                self.consensus, wait_sync=self.fast_sync or self.state_sync
+            )
             self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
             self.switch.add_reactor("MEMPOOL", MempoolReactor(self.mempool))
             self.switch.add_reactor("EVIDENCE", EvidenceReactor(self.evidence_pool))
             from tendermint_tpu.blocksync.reactor import BlocksyncReactor
 
+            # a state-sync node starts blocksync only after the snapshot
+            # restore (switch_to_blocksync handoff)
             self.blocksync_reactor = BlocksyncReactor(
                 state, self.block_exec, self.block_store,
-                consensus_reactor=self.consensus_reactor, active=self.fast_sync,
+                consensus_reactor=self.consensus_reactor,
+                active=self.fast_sync and not self.state_sync,
             )
             self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
+            from tendermint_tpu.statesync.reactor import StatesyncReactor
+
+            self.statesync_reactor = StatesyncReactor(
+                self.proxy_app.snapshot, self.proxy_app.query, active=self.state_sync
+            )
+            self.switch.add_reactor("STATESYNC", self.statesync_reactor)
+        else:
+            self.state_sync = False
 
     async def start(self) -> None:
         self._running = True
         await self.indexer_service.start()
-        if not (self.switch is not None and self.fast_sync):
-            # with fast sync active, consensus starts at the blocksync handoff
-            # (reference: node/node.go:897 startStateSync -> SwitchToConsensus)
+        if not (self.switch is not None and (self.fast_sync or self.state_sync)):
+            # with fast/state sync active, consensus starts at the blocksync
+            # handoff (reference: node/node.go:897 startStateSync -> SwitchToConsensus)
             await self.consensus.start()
         if self.switch is not None:
             await self.switch.start()
@@ -214,7 +234,54 @@ class Node:
 
             self.rpc_server = RPCServer(self)
             await self.rpc_server.start()
+        if self.state_sync:
+            self._statesync_task = asyncio.create_task(
+                self._run_state_sync(), name="statesync"
+            )
         logger.info("node started (chain %s)", self.genesis.chain_id)
+
+    async def _run_state_sync(self) -> None:
+        """Restore from a peer snapshot, bootstrap the stores, then hand off
+        to block sync (reference: node/node.go:560 startStateSync)."""
+        cfg = self.config.statesync
+        provider = self._state_provider
+        if provider is None:
+            from tendermint_tpu.rpc.client import HTTPClient
+            from tendermint_tpu.statesync.stateprovider import (
+                LightClientStateProvider,
+            )
+
+            provider = LightClientStateProvider(
+                self.genesis.chain_id,
+                [HTTPClient(u) for u in cfg.rpc_servers],
+                cfg.trust_height,
+                bytes.fromhex(cfg.trust_hash),
+                int(cfg.trust_period * 1_000_000_000),
+            )
+        try:
+            state, commit = await self.statesync_reactor.sync(
+                provider,
+                cfg.discovery_time,
+                chunk_fetchers=cfg.chunk_fetchers,
+                chunk_timeout=cfg.chunk_request_timeout,
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # fall back to block sync from genesis rather than wedging the
+            # node in wait_sync forever
+            logger.exception("state sync failed; falling back to block sync")
+            await self.blocksync_reactor.switch_to_blocksync(self.state)
+            return
+        self.state_store.bootstrap(state)
+        self.block_store.save_seen_commit(state.last_block_height, commit)
+        self.state = state
+        self.evidence_pool.set_state(state)
+        logger.info(
+            "state synced to height %d; switching to block sync",
+            state.last_block_height,
+        )
+        await self.blocksync_reactor.switch_to_blocksync(state)
 
     @staticmethod
     def _parse_laddr(laddr: str) -> tuple:
@@ -224,6 +291,8 @@ class Node:
 
     async def stop(self) -> None:
         self._running = False
+        if self._statesync_task is not None:
+            self._statesync_task.cancel()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
         if self.switch is not None:

@@ -97,6 +97,9 @@ class HTTPClient:
     async def consensus_state(self):
         return await self.call("consensus_state")
 
+    async def consensus_params(self, height=None):
+        return await self.call("consensus_params", height=height)
+
     async def dump_consensus_state(self):
         return await self.call("dump_consensus_state")
 

@@ -1,0 +1,18 @@
+"""State sync: bootstrap a node from an app snapshot instead of replay.
+
+reference: statesync/ — syncer.go, reactor.go, chunks.go, snapshots.go,
+stateprovider.go.
+"""
+
+from tendermint_tpu.statesync.chunks import Chunk, ChunkQueue  # noqa: F401
+from tendermint_tpu.statesync.reactor import StatesyncReactor  # noqa: F401
+from tendermint_tpu.statesync.snapshots import Snapshot, SnapshotPool  # noqa: F401
+from tendermint_tpu.statesync.stateprovider import (  # noqa: F401
+    LightClientStateProvider,
+    StateProvider,
+)
+from tendermint_tpu.statesync.syncer import (  # noqa: F401
+    ErrNoSnapshots,
+    SyncError,
+    Syncer,
+)

@@ -1,0 +1,156 @@
+"""State sync reactor: serves snapshots to peers + drives the local syncer.
+
+reference: statesync/reactor.go — channels (:18-20), Receive (:98), Sync
+(:248), recentSnapshots (:73).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional, Tuple
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.state.sm_state import State
+from tendermint_tpu.statesync.chunks import Chunk
+from tendermint_tpu.statesync.messages import (
+    CHUNK_CHANNEL,
+    CHUNK_MSG_SIZE,
+    SNAPSHOT_CHANNEL,
+    SNAPSHOT_MSG_SIZE,
+    ChunkRequest,
+    ChunkResponse,
+    SnapshotsRequest,
+    SnapshotsResponse,
+    decode_message,
+    encode_message,
+)
+from tendermint_tpu.statesync.snapshots import Snapshot
+from tendermint_tpu.statesync.syncer import Syncer
+from tendermint_tpu.types.block import Commit
+
+logger = logging.getLogger("tendermint_tpu.statesync")
+
+RECENT_SNAPSHOTS = 10  # reference: statesync/reactor.go:73
+
+
+class StatesyncReactor(Reactor):
+    def __init__(self, conn_snapshot, conn_query, active: bool = False):
+        super().__init__("STATESYNC")
+        self.conn_snapshot = conn_snapshot
+        self.conn_query = conn_query
+        self.active = active  # True = we are syncing; False = serve only
+        self.syncer: Optional[Syncer] = None
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                SNAPSHOT_CHANNEL, priority=5,
+                send_queue_capacity=10, recv_message_capacity=SNAPSHOT_MSG_SIZE,
+            ),
+            ChannelDescriptor(
+                CHUNK_CHANNEL, priority=3,
+                send_queue_capacity=4, recv_message_capacity=CHUNK_MSG_SIZE,
+            ),
+        ]
+
+    # ----------------------------------------------------------------- peers
+
+    async def add_peer(self, peer) -> None:
+        """Ask every new peer for its snapshots while syncing
+        (reference: reactor.go:221 AddPeer)."""
+        if self.active:
+            await peer.send(SNAPSHOT_CHANNEL, encode_message(SnapshotsRequest()))
+
+    async def remove_peer(self, peer, reason) -> None:
+        if self.syncer is not None:
+            self.syncer.remove_peer(peer.id)
+
+    # --------------------------------------------------------------- receive
+
+    async def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            msg = decode_message(msg_bytes)
+        except Exception as e:
+            await self.switch.stop_peer_for_error(peer, e)
+            return
+
+        if isinstance(msg, SnapshotsRequest):
+            # serve our app's recent snapshots (reference: reactor.go:110)
+            for s in self._recent_snapshots(RECENT_SNAPSHOTS):
+                await peer.send(
+                    SNAPSHOT_CHANNEL,
+                    encode_message(
+                        SnapshotsResponse(s.height, s.format, s.chunks, s.hash, s.metadata)
+                    ),
+                )
+        elif isinstance(msg, SnapshotsResponse):
+            if self.syncer is not None:
+                try:
+                    msg.validate_basic()
+                except ValueError as e:
+                    await self.switch.stop_peer_for_error(peer, e)
+                    return
+                self.syncer.add_snapshot(
+                    peer.id,
+                    Snapshot(msg.height, msg.format, msg.chunks, msg.hash, msg.metadata),
+                )
+        elif isinstance(msg, ChunkRequest):
+            # load from the app (reference: reactor.go:151)
+            resp = self.conn_snapshot.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(msg.height, msg.format, msg.index)
+            )
+            await peer.send(
+                CHUNK_CHANNEL,
+                encode_message(
+                    ChunkResponse(
+                        msg.height, msg.format, msg.index,
+                        resp.chunk, missing=not resp.chunk,
+                    )
+                ),
+            )
+        elif isinstance(msg, ChunkResponse):
+            if self.syncer is not None and not msg.missing:
+                self.syncer.add_chunk(
+                    Chunk(msg.height, msg.format, msg.index, msg.chunk, peer.id)
+                )
+
+    def _recent_snapshots(self, n: int) -> List[Snapshot]:
+        resp = self.conn_snapshot.list_snapshots()
+        snaps = sorted(
+            resp.snapshots, key=lambda s: (-s.height, -s.format)
+        )[:n]
+        return [
+            Snapshot(s.height, s.format, s.chunks, s.hash, s.metadata) for s in snaps
+        ]
+
+    # ------------------------------------------------------------------ sync
+
+    async def sync(self, state_provider, discovery_time: float,
+                   chunk_fetchers: int = 4, chunk_timeout: float = 120.0) -> Tuple[State, Commit]:
+        """Run the full state sync (reference: reactor.go:248 Sync)."""
+        if self.syncer is not None:
+            raise RuntimeError("a state sync is already in progress")
+        self.syncer = Syncer(
+            state_provider,
+            self.conn_snapshot,
+            self.conn_query,
+            self._request_chunk,
+            chunk_fetchers=chunk_fetchers,
+            chunk_timeout=chunk_timeout,
+        )
+        try:
+            # ask everyone already connected (late peers hit add_peer)
+            await self.switch.broadcast(
+                SNAPSHOT_CHANNEL, encode_message(SnapshotsRequest())
+            )
+            return await self.syncer.sync_any(discovery_time)
+        finally:
+            self.syncer = None
+
+    async def _request_chunk(self, peer_id: str, height: int, fmt: int, index: int) -> None:
+        peer = self.switch.peers.get(peer_id)
+        if peer is not None:
+            await peer.send(CHUNK_CHANNEL, encode_message(ChunkRequest(height, fmt, index)))

@@ -1,0 +1,279 @@
+"""Syncer: restores state machine snapshots via ABCI + verifies via light client.
+
+reference: statesync/syncer.go — syncer (:38), AddSnapshot (:78), SyncAny
+(:130), Sync (:217), offerSnapshot (:276), applyChunks (:312), fetchChunks
+(:369), requestChunk (:402), verifyApp (:423).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Optional, Tuple
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.state.sm_state import State
+from tendermint_tpu.statesync.chunks import Chunk, ChunkQueue, ChunkQueueClosed
+from tendermint_tpu.statesync.snapshots import Snapshot, SnapshotPool
+from tendermint_tpu.statesync.stateprovider import StateProvider
+from tendermint_tpu.types.block import Commit
+
+logger = logging.getLogger("tendermint_tpu.statesync")
+
+# reference: statesync/syncer.go:21-35
+CHUNK_TIMEOUT = 2 * 60.0
+MIN_SNAPSHOT_PEERS = 1
+
+
+class SyncError(Exception):
+    pass
+
+
+class ErrNoSnapshots(SyncError):
+    """reference: statesync/syncer.go errNoSnapshots."""
+
+
+class ErrAbort(SyncError):
+    """App returned ABORT (reference: errAbort)."""
+
+
+class ErrRejectSnapshot(SyncError):
+    pass
+
+
+class ErrRejectFormat(SyncError):
+    pass
+
+
+class ErrRejectSender(SyncError):
+    pass
+
+
+class ErrVerifyFailed(SyncError):
+    """App hash or height mismatch after restore (reference: errVerifyFailed)."""
+
+
+class Syncer:
+    """reference: statesync/syncer.go:38.
+
+    request_chunk(peer_id, height, format, index) is an async callback into
+    the reactor; conn_snapshot/conn_query are ABCI clients (snapshot + query
+    connections of the 4-conn proxy)."""
+
+    def __init__(
+        self,
+        state_provider: StateProvider,
+        conn_snapshot,
+        conn_query,
+        request_chunk: Callable,
+        chunk_fetchers: int = 4,
+        chunk_timeout: float = CHUNK_TIMEOUT,
+    ):
+        self.state_provider = state_provider
+        self.conn_snapshot = conn_snapshot
+        self.conn_query = conn_query
+        self.request_chunk = request_chunk
+        self.chunk_fetchers = chunk_fetchers
+        self.chunk_timeout = chunk_timeout
+        self.snapshots = SnapshotPool()
+        self.chunk_queue: Optional[ChunkQueue] = None
+        self._processing: Optional[Snapshot] = None
+
+    # ---------------------------------------------------------------- intake
+
+    def add_snapshot(self, peer_id: str, snapshot: Snapshot) -> bool:
+        """reference: syncer.go:78 AddSnapshot."""
+        added = self.snapshots.add(peer_id, snapshot)
+        if added:
+            logger.info(
+                "discovered snapshot height=%d format=%d chunks=%d from %s",
+                snapshot.height, snapshot.format, snapshot.chunks, peer_id[:10],
+            )
+        return added
+
+    def add_chunk(self, chunk: Chunk) -> bool:
+        """reference: syncer.go:110 AddChunk."""
+        q = self.chunk_queue
+        if q is None or self._processing is None:
+            return False
+        if chunk.height != self._processing.height or chunk.format != self._processing.format:
+            return False
+        return q.add(chunk)
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.snapshots.remove_peer(peer_id)
+
+    # ------------------------------------------------------------------ sync
+
+    async def sync_any(self, discovery_time: float) -> Tuple[State, Commit]:
+        """Try snapshots best-first until one restores
+        (reference: syncer.go:130 SyncAny)."""
+        if discovery_time > 0:
+            logger.info("discovering snapshots for %.1fs", discovery_time)
+            await asyncio.sleep(discovery_time)
+        while True:
+            snapshot = self.snapshots.best()
+            if snapshot is None:
+                raise ErrNoSnapshots("no viable snapshots found")
+            try:
+                return await self.sync(snapshot)
+            except ErrRejectSnapshot:
+                logger.info("snapshot height=%d rejected; trying next", snapshot.height)
+                self.snapshots.reject(snapshot)
+            except ErrRejectFormat:
+                logger.info("snapshot format %d rejected; trying next", snapshot.format)
+                self.snapshots.reject_format(snapshot.format)
+            except ErrRejectSender:
+                logger.info("snapshot senders rejected; trying next")
+                for peer_id in self.snapshots.get_peers(snapshot):
+                    self.snapshots.reject_peer(peer_id)
+                self.snapshots.reject(snapshot)
+            except ErrVerifyFailed:
+                logger.warning("snapshot height=%d failed verification; trying next", snapshot.height)
+                self.snapshots.reject(snapshot)
+            finally:
+                if self.chunk_queue is not None:
+                    self.chunk_queue.close()
+                self.chunk_queue = None
+                self._processing = None
+
+    async def sync(self, snapshot: Snapshot) -> Tuple[State, Commit]:
+        """Restore one snapshot (reference: syncer.go:217 Sync)."""
+        # fetch the trusted app hash BEFORE offering (reference: :226)
+        app_hash = await self.state_provider.app_hash(snapshot.height)
+        snapshot = Snapshot(
+            snapshot.height, snapshot.format, snapshot.chunks,
+            snapshot.hash, snapshot.metadata, trusted_app_hash=app_hash,
+        )
+        self._processing = snapshot
+        self.chunk_queue = ChunkQueue(snapshot)
+
+        await self._offer_snapshot(snapshot)
+
+        fetchers = [
+            asyncio.create_task(self._fetch_chunks(), name=f"ss-fetch-{i}")
+            for i in range(self.chunk_fetchers)
+        ]
+        # concurrently: build verified state via light client + apply chunks;
+        # gather surfaces the FIRST failure immediately so a dead light
+        # client aborts the sync instead of waiting out slow chunk peers
+        state_task = asyncio.create_task(self.state_provider.state(snapshot.height))
+        commit_task = asyncio.create_task(self.state_provider.commit(snapshot.height))
+        apply_task = asyncio.create_task(self._apply_chunks(self.chunk_queue))
+        try:
+            _, state, commit = await asyncio.gather(apply_task, state_task, commit_task)
+        except BaseException:
+            for t in (apply_task, state_task, commit_task):
+                t.cancel()
+            raise
+        finally:
+            for f in fetchers:
+                f.cancel()
+
+        await self._verify_app(snapshot, state)
+        logger.info("snapshot restored at height %d", snapshot.height)
+        return state, commit
+
+    async def _offer_snapshot(self, snapshot: Snapshot) -> None:
+        """reference: syncer.go:276 offerSnapshot."""
+        resp = self.conn_snapshot.offer_snapshot(
+            abci.RequestOfferSnapshot(
+                snapshot=abci.Snapshot(
+                    height=snapshot.height,
+                    format=snapshot.format,
+                    chunks=snapshot.chunks,
+                    hash=snapshot.hash,
+                    metadata=snapshot.metadata,
+                ),
+                app_hash=snapshot.trusted_app_hash,
+            )
+        )
+        r = resp.result
+        if r == abci.OFFER_SNAPSHOT_ACCEPT:
+            logger.info("snapshot height=%d format=%d accepted", snapshot.height, snapshot.format)
+        elif r == abci.OFFER_SNAPSHOT_ABORT:
+            raise ErrAbort("app aborted state sync")
+        elif r == abci.OFFER_SNAPSHOT_REJECT:
+            raise ErrRejectSnapshot("app rejected snapshot")
+        elif r == abci.OFFER_SNAPSHOT_REJECT_FORMAT:
+            raise ErrRejectFormat("app rejected format")
+        elif r == abci.OFFER_SNAPSHOT_REJECT_SENDER:
+            raise ErrRejectSender("app rejected senders")
+        else:
+            raise SyncError(f"unknown OfferSnapshot result {r}")
+
+    async def _fetch_chunks(self) -> None:
+        """One fetcher worker (reference: syncer.go:369 fetchChunks)."""
+        import random
+
+        q = self.chunk_queue
+        snapshot = self._processing
+        try:
+            while True:
+                index = q.allocate()
+                if index is None:
+                    if q.done():
+                        return
+                    await asyncio.sleep(0.05)
+                    continue
+                peers = self.snapshots.get_peers(snapshot)
+                if peers:
+                    # random peer per request so a silent-but-connected peer
+                    # can't pin a chunk forever (reference: syncer.go:402)
+                    peer_id = random.choice(peers)
+                    await self.request_chunk(peer_id, snapshot.height, snapshot.format, index)
+                # wait for it to arrive; retry on timeout (reference: :390)
+                deadline = asyncio.get_event_loop().time() + self.chunk_timeout
+                while not q.has(index) and index not in q._returned:
+                    if asyncio.get_event_loop().time() > deadline:
+                        q.retry(index)
+                        break
+                    await asyncio.sleep(0.05)
+        except (asyncio.CancelledError, ChunkQueueClosed):
+            pass
+
+    async def _apply_chunks(self, q: ChunkQueue) -> None:
+        """reference: syncer.go:312 applyChunks."""
+        while True:
+            chunk = await q.next()
+            resp = self.conn_snapshot.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(
+                    index=chunk.index, chunk=chunk.chunk, sender=chunk.sender
+                )
+            )
+            # punishment lists apply regardless of result (reference: :330)
+            for peer_id in resp.reject_senders:
+                self.snapshots.reject_peer(peer_id)
+                q.discard_sender(peer_id)
+            for index in resp.refetch_chunks:
+                q.retry(index)
+
+            r = resp.result
+            if r == abci.APPLY_SNAPSHOT_CHUNK_ACCEPT:
+                if q.done():
+                    return
+            elif r == abci.APPLY_SNAPSHOT_CHUNK_ABORT:
+                raise ErrAbort("app aborted chunk apply")
+            elif r == abci.APPLY_SNAPSHOT_CHUNK_RETRY:
+                q.retry(chunk.index)
+            elif r == abci.APPLY_SNAPSHOT_CHUNK_RETRY_SNAPSHOT:
+                q.retry_all()
+            elif r == abci.APPLY_SNAPSHOT_CHUNK_REJECT_SNAPSHOT:
+                raise ErrRejectSnapshot("app rejected snapshot during chunk apply")
+            else:
+                raise SyncError(f"unknown ApplySnapshotChunk result {r}")
+
+    async def _verify_app(self, snapshot: Snapshot, state: State) -> None:
+        """The app must now report the trusted hash/height
+        (reference: syncer.go:423 verifyApp)."""
+        resp = self.conn_query.info(abci.RequestInfo())
+        if resp.last_block_app_hash != snapshot.trusted_app_hash:
+            raise ErrVerifyFailed(
+                f"app hash mismatch: expected {snapshot.trusted_app_hash.hex()}, "
+                f"got {resp.last_block_app_hash.hex()}"
+            )
+        if resp.last_block_height != snapshot.height:
+            raise ErrVerifyFailed(
+                f"app height mismatch: expected {snapshot.height}, "
+                f"got {resp.last_block_height}"
+            )
