@@ -22,6 +22,11 @@ class BaseConfig:
     genesis_file: str = "config/genesis.json"
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
+    # remote signer address the node DIALS, e.g. "tcp://127.0.0.1:26659".
+    # Fills the role of the reference's PrivValidatorListenAddr
+    # (config/config.go) with the dial direction inverted: here the signer
+    # listens and the node connects (see privval/remote.py).
+    priv_validator_addr: str = ""
     node_key_file: str = "config/node_key.json"
     abci: str = "kvstore"
     filter_peers: bool = False

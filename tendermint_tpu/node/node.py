@@ -65,6 +65,15 @@ class Node:
     ):
         self.config = config
         self.genesis = genesis
+        self._owns_priv_validator = False
+        if priv_validator is None and config.base.priv_validator_addr:
+            # dial the remote signer (reference: node/node.go:658
+            # createAndStartPrivValidatorSocketClient)
+            from tendermint_tpu.privval.remote import SignerClient
+
+            host, port = self._parse_laddr(config.base.priv_validator_addr)
+            priv_validator = SignerClient(host, port)
+            self._owns_priv_validator = True
         self.priv_validator = priv_validator
 
         # databases
@@ -299,6 +308,8 @@ class Node:
             await self.switch.stop()
         await self.consensus.stop()
         await self.indexer_service.stop()
+        if self._owns_priv_validator:
+            self.priv_validator.close()
         self.proxy_app.stop()
         for db in (self.block_db, self.state_db, self.evidence_db):
             db.close()

@@ -1,0 +1,294 @@
+"""Remote signer: PrivValidator over a socket.
+
+reference: privval/signer_client.go:16 (SignerClient), signer_server.go:18
+(SignerServer), msgs.go (message envelope), signer_endpoint.go (framing),
+proto/tendermint/privval/types.proto.
+
+Framing: 4-byte big-endian length prefix + protowire envelope. The client is
+deliberately BLOCKING (the reference's SignerClient is too): consensus signs
+at most one vote/proposal at a time, and the loopback round-trip is far below
+the consensus step timeouts. The server runs in its own thread (standing in
+for the external signer process, e.g. a tmkms-style HSM host).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Optional
+
+from tendermint_tpu.crypto.keys import PubKey, pubkey_from_type_and_bytes
+from tendermint_tpu.libs import protowire as pw
+from tendermint_tpu.privval.file_pv import DoubleSignError, FilePV
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+logger = logging.getLogger("tendermint_tpu.privval")
+
+# envelope fields (reference: proto/tendermint/privval/types.proto Message)
+F_PUBKEY_REQ = 1
+F_PUBKEY_RESP = 2
+F_SIGN_VOTE_REQ = 3
+F_SIGNED_VOTE_RESP = 4
+F_SIGN_PROPOSAL_REQ = 5
+F_SIGNED_PROPOSAL_RESP = 6
+F_PING_REQ = 7
+F_PING_RESP = 8
+
+# RemoteSignerError codes (reference: privval/errors.go)
+ERR_DOUBLE_SIGN = 1
+ERR_GENERIC = 2
+
+
+class RemoteSignerError(Exception):
+    def __init__(self, code: int, description: str):
+        self.code = code
+        self.description = description
+        super().__init__(f"remote signer error (code {code}): {description}")
+
+
+def _err_body(code: int, description: str) -> bytes:
+    w = pw.Writer()
+    w.varint_field(1, code)
+    w.string_field(2, description)
+    return w.bytes()
+
+
+def _parse_err(data: bytes) -> RemoteSignerError:
+    code = 0
+    desc = ""
+    for f, _, v in pw.Reader(data):
+        if f == 1:
+            code = v
+        elif f == 2:
+            desc = v.decode("utf-8", "replace")
+    return RemoteSignerError(code, desc)
+
+
+def _envelope(field: int, body: bytes) -> bytes:
+    w = pw.Writer()
+    w.message_field(field, body, always=True)
+    payload = w.bytes()
+    return struct.pack(">I", len(payload)) + payload
+
+
+def _read_frame(sock: socket.socket) -> bytes:
+    hdr = _read_exact(sock, 4)
+    (n,) = struct.unpack(">I", hdr)
+    if n > 1 << 20:
+        raise ValueError(f"privval frame too large: {n}")
+    return _read_exact(sock, n)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("privval connection closed")
+        buf += chunk
+    return buf
+
+
+def _decode_envelope(payload: bytes):
+    for f, _, v in pw.Reader(payload):
+        return f, v
+    raise ValueError("empty privval message")
+
+
+class SignerServer:
+    """Serves a FilePV over a listening socket in a background thread
+    (reference: privval/signer_server.go:18 + signer_listener_endpoint; the
+    dial direction is inverted — we listen, the node dials — matching the
+    reference's tcp:// SignerListenerEndpoint topology from the node's view)."""
+
+    def __init__(self, pv: FilePV, chain_id: str, host: str = "127.0.0.1", port: int = 0):
+        self.pv = pv
+        self.chain_id = chain_id
+        self._listener = socket.create_server((host, port))
+        self.addr = self._listener.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True, name="signer-server")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._handle, args=(conn,), daemon=True)
+            t.start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    payload = _read_frame(conn)
+                except (ConnectionError, OSError, ValueError):
+                    return
+                try:
+                    resp = self._dispatch(payload)
+                except Exception as e:  # never kill the loop on one bad msg
+                    logger.exception("signer dispatch failed")
+                    resp = _envelope(F_PING_RESP, _err_body(ERR_GENERIC, str(e)))
+                try:
+                    conn.sendall(resp)
+                except OSError:
+                    return
+
+    def _dispatch(self, payload: bytes) -> bytes:
+        field, body = _decode_envelope(payload)
+        if field == F_PING_REQ:
+            return _envelope(F_PING_RESP, b"")
+        if field == F_PUBKEY_REQ:
+            pub = self.pv.get_pub_key()
+            w = pw.Writer()
+            w.string_field(1, pub.type_name())
+            w.bytes_field(2, pub.bytes())
+            return _envelope(F_PUBKEY_RESP, w.bytes())
+        if field == F_SIGN_VOTE_REQ:
+            vote = chain_id = None
+            for f, _, v in pw.Reader(body):
+                if f == 1:
+                    vote = Vote.decode(v)
+                elif f == 2:
+                    chain_id = v.decode("utf-8")
+            try:
+                signed = self.pv.sign_vote(chain_id or self.chain_id, vote)
+            except DoubleSignError as e:
+                return _envelope(F_SIGNED_VOTE_RESP, self._err_resp(ERR_DOUBLE_SIGN, e))
+            except Exception as e:
+                return _envelope(F_SIGNED_VOTE_RESP, self._err_resp(ERR_GENERIC, e))
+            w = pw.Writer()
+            w.message_field(1, signed.encode(), always=True)
+            return _envelope(F_SIGNED_VOTE_RESP, w.bytes())
+        if field == F_SIGN_PROPOSAL_REQ:
+            prop = chain_id = None
+            for f, _, v in pw.Reader(body):
+                if f == 1:
+                    prop = Proposal.decode(v)
+                elif f == 2:
+                    chain_id = v.decode("utf-8")
+            try:
+                signed = self.pv.sign_proposal(chain_id or self.chain_id, prop)
+            except DoubleSignError as e:
+                return _envelope(F_SIGNED_PROPOSAL_RESP, self._err_resp(ERR_DOUBLE_SIGN, e))
+            except Exception as e:
+                return _envelope(F_SIGNED_PROPOSAL_RESP, self._err_resp(ERR_GENERIC, e))
+            w = pw.Writer()
+            w.message_field(1, signed.encode(), always=True)
+            return _envelope(F_SIGNED_PROPOSAL_RESP, w.bytes())
+        raise ValueError(f"unknown privval request field {field}")
+
+    @staticmethod
+    def _err_resp(code: int, e: Exception) -> bytes:
+        w = pw.Writer()
+        w.message_field(2, _err_body(code, str(e)), always=True)
+        return w.bytes()
+
+
+class SignerClient:
+    """PrivValidator that signs via a remote SignerServer
+    (reference: privval/signer_client.go:16)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._pub_key: Optional[PubKey] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _call(self, field: int, body: bytes, want: int) -> bytes:
+        with self._lock:
+            for attempt in (0, 1):  # one reconnect on a broken pipe
+                try:
+                    sock = self._connect()
+                    sock.sendall(_envelope(field, body))
+                    payload = _read_frame(sock)
+                    break
+                except (ConnectionError, OSError):
+                    self.close()
+                    if attempt:
+                        raise
+        got, resp = _decode_envelope(payload)
+        if got != want:
+            raise RemoteSignerError(ERR_GENERIC, f"unexpected response field {got}, want {want}")
+        return resp
+
+    def ping(self) -> None:
+        self._call(F_PING_REQ, b"", F_PING_RESP)
+
+    # -- PrivValidator interface -------------------------------------------
+
+    def get_pub_key(self) -> PubKey:
+        if self._pub_key is None:
+            resp = self._call(F_PUBKEY_REQ, b"", F_PUBKEY_RESP)
+            type_name = "ed25519"
+            data = b""
+            for f, _, v in pw.Reader(resp):
+                if f == 1:
+                    type_name = v.decode("utf-8")
+                elif f == 2:
+                    data = v
+            self._pub_key = pubkey_from_type_and_bytes(type_name, data)
+        return self._pub_key
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        w = pw.Writer()
+        w.message_field(1, vote.encode(), always=True)
+        w.string_field(2, chain_id)
+        resp = self._call(F_SIGN_VOTE_REQ, w.bytes(), F_SIGNED_VOTE_RESP)
+        signed = err = None
+        for f, _, v in pw.Reader(resp):
+            if f == 1:
+                signed = Vote.decode(v)
+            elif f == 2:
+                err = _parse_err(v)
+        if err is not None:
+            if err.code == ERR_DOUBLE_SIGN:
+                raise DoubleSignError(err.description)
+            raise err
+        return signed
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        w = pw.Writer()
+        w.message_field(1, proposal.encode(), always=True)
+        w.string_field(2, chain_id)
+        resp = self._call(F_SIGN_PROPOSAL_REQ, w.bytes(), F_SIGNED_PROPOSAL_RESP)
+        signed = err = None
+        for f, _, v in pw.Reader(resp):
+            if f == 1:
+                signed = Proposal.decode(v)
+            elif f == 2:
+                err = _parse_err(v)
+        if err is not None:
+            if err.code == ERR_DOUBLE_SIGN:
+                raise DoubleSignError(err.description)
+            raise err
+        return signed

@@ -1,0 +1,161 @@
+"""Remote signer over socket: signing, idempotent re-sign, double-sign
+rejection, and a node producing blocks through a SignerClient
+(reference test model: privval/signer_client_test.go)."""
+
+import asyncio
+import os
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.crypto import gen_ed25519, tmhash
+from tendermint_tpu.privval.file_pv import DoubleSignError, FilePV
+from tendermint_tpu.privval.remote import SignerClient, SignerServer
+from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+CHAIN = "remote-chain"
+
+
+def make_vote(height, round_=0, type_=SignedMsgType.PREVOTE, ts=1_000, tag=b"a"):
+    h = tmhash.sum256(tag)
+    return Vote(
+        type=type_,
+        height=height,
+        round=round_,
+        block_id=BlockID(h, PartSetHeader(1, tmhash.sum256(h))),
+        timestamp_ns=ts,
+        validator_address=b"\x01" * 20,
+        validator_index=0,
+    )
+
+
+@pytest.fixture()
+def signer():
+    pv = FilePV(gen_ed25519(b"\x42" * 32))
+    server = SignerServer(pv, CHAIN)
+    server.start()
+    client = SignerClient("127.0.0.1", server.addr[1])
+    yield pv, client
+    client.close()
+    server.stop()
+
+
+def test_pubkey_ping_and_sign_vote(signer):
+    pv, client = signer
+    client.ping()
+    assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+
+    vote = make_vote(1)
+    signed = client.sign_vote(CHAIN, vote)
+    assert pv.get_pub_key().verify(vote.sign_bytes(CHAIN), signed.signature)
+
+    # identical payload re-signs idempotently
+    again = client.sign_vote(CHAIN, vote)
+    assert again.signature == signed.signature
+
+    # same HRS differing only by timestamp: reuses previous signature+ts
+    ts_only = make_vote(1, ts=2_000)
+    resigned = client.sign_vote(CHAIN, ts_only)
+    assert resigned.signature == signed.signature
+    assert resigned.timestamp_ns == 1_000
+
+
+def test_double_sign_rejected_over_socket(signer):
+    _, client = signer
+    client.sign_vote(CHAIN, make_vote(5, tag=b"a"))
+    # same HRS, different block: equivocation
+    with pytest.raises(DoubleSignError):
+        client.sign_vote(CHAIN, make_vote(5, tag=b"b"))
+    # height regression
+    with pytest.raises(DoubleSignError):
+        client.sign_vote(CHAIN, make_vote(4))
+    # higher height is fine after errors
+    ok = client.sign_vote(CHAIN, make_vote(6))
+    assert ok.signature
+
+
+def test_sign_proposal_over_socket(signer):
+    pv, client = signer
+    h = tmhash.sum256(b"p")
+    prop = Proposal(
+        type=SignedMsgType.PROPOSAL,
+        height=3,
+        round=0,
+        pol_round=-1,
+        block_id=BlockID(h, PartSetHeader(1, tmhash.sum256(h))),
+        timestamp_ns=7_000,
+    )
+    signed = client.sign_proposal(CHAIN, prop)
+    assert pv.get_pub_key().verify(prop.sign_bytes(CHAIN), signed.signature)
+
+
+def test_node_signs_through_remote_signer(tmp_path):
+    """A single-validator node drives consensus entirely through the socket
+    signer (reference: node/node.go:658 createAndStartPrivValidatorSocketClient)."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    pv = FilePV(gen_ed25519(b"\x43" * 32))
+    server = SignerServer(pv, "remote-node-chain")
+    server.start()
+    client = SignerClient("127.0.0.1", server.addr[1])
+
+    async def run():
+        cfg = test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = ""
+        cfg.root_dir = ""
+        cfg.consensus.wal_path = str(tmp_path / "wal")
+        gen = GenesisDoc(
+            chain_id="remote-node-chain",
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+        node = Node(cfg, gen, priv_validator=client, app=KVStoreApplication())
+        await node.start()
+        try:
+            await node.wait_for_height(3, timeout=60)
+        finally:
+            await node.stop()
+        # the local FilePV behind the socket advanced its sign state
+        assert pv.last_sign_state.height >= 3
+
+    try:
+        asyncio.run(run())
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_node_builds_signer_client_from_config(tmp_path):
+    """priv_validator_addr in config wires a SignerClient automatically
+    (reference: config/config.go PrivValidatorListenAddr)."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.remote import SignerClient as SC
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    pv = FilePV(gen_ed25519(b"\x44" * 32))
+    server = SignerServer(pv, "cfg-chain")
+    server.start()
+    try:
+        cfg = test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = ""
+        cfg.root_dir = ""
+        cfg.consensus.wal_path = str(tmp_path / "wal")
+        cfg.base.priv_validator_addr = f"tcp://127.0.0.1:{server.addr[1]}"
+        gen = GenesisDoc(
+            chain_id="cfg-chain", validators=[GenesisValidator(pv.get_pub_key(), 10)]
+        )
+        node = Node(cfg, gen, app=KVStoreApplication())
+        assert isinstance(node.priv_validator, SC)
+        assert node.priv_validator.get_pub_key().bytes() == pv.get_pub_key().bytes()
+        node.priv_validator.close()
+    finally:
+        server.stop()
