@@ -159,6 +159,8 @@ class Node:
         self.consensus_reactor = None
         self.blocksync_reactor = None
         self.statesync_reactor = None
+        self.addr_book = None
+        self.pex_reactor = None
         self.fast_sync = False
         # state sync only makes sense on an empty chain
         # (reference: node/node.go:672 decide stateSync)
@@ -221,6 +223,23 @@ class Node:
                 self.proxy_app.snapshot, self.proxy_app.query, active=self.state_sync
             )
             self.switch.add_reactor("STATESYNC", self.statesync_reactor)
+            if config.p2p.pex:
+                from tendermint_tpu.p2p.pex import AddrBook, PexReactor
+
+                book_file = (
+                    os.path.join(config.root_dir, "config", "addrbook.json")
+                    if config.root_dir
+                    else None
+                )
+                self.addr_book = AddrBook(book_file)
+                seeds = [s.strip() for s in config.p2p.seeds.split(",") if s.strip()]
+                self.pex_reactor = PexReactor(
+                    self.addr_book,
+                    seeds=seeds,
+                    max_outbound=config.p2p.max_num_outbound_peers,
+                    seed_mode=config.p2p.seed_mode,
+                )
+                self.switch.add_reactor("PEX", self.pex_reactor)
         else:
             self.state_sync = False
 

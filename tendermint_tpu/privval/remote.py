@@ -102,11 +102,28 @@ class SignerServer:
     """Serves a FilePV over a listening socket in a background thread
     (reference: privval/signer_server.go:18 + signer_listener_endpoint; the
     dial direction is inverted — we listen, the node dials — matching the
-    reference's tcp:// SignerListenerEndpoint topology from the node's view)."""
+    reference's tcp:// SignerListenerEndpoint topology from the node's view).
 
-    def __init__(self, pv: FilePV, chain_id: str, host: str = "127.0.0.1", port: int = 0):
+    All signing serializes on one lock: FilePV's double-sign guard is
+    check-then-act, so concurrent connections must never race it.
+
+    authorized_keys: optional list of client PubKeys. When set, each
+    connection must pass a challenge-response (sign a server nonce with its
+    node key) before any request is served — this closes the signing-oracle
+    hole when the socket is reachable beyond loopback (the reference uses a
+    SecretConnection for the same purpose)."""
+
+    def __init__(self, pv: FilePV, chain_id: str, host: str = "127.0.0.1", port: int = 0,
+                 authorized_keys=None):
         self.pv = pv
         self.chain_id = chain_id
+        self.authorized_keys = list(authorized_keys or [])
+        if not self.authorized_keys and host not in ("127.0.0.1", "::1", "localhost"):
+            logger.warning(
+                "privval signer listening on %s WITHOUT client authentication — "
+                "anyone who can reach this port can request signatures", host
+            )
+        self._lock = threading.Lock()
         self._listener = socket.create_server((host, port))
         self.addr = self._listener.getsockname()
         self._stop = threading.Event()
@@ -133,6 +150,8 @@ class SignerServer:
 
     def _handle(self, conn: socket.socket) -> None:
         with conn:
+            if self.authorized_keys and not self._authenticate(conn):
+                return
             while not self._stop.is_set():
                 try:
                     payload = _read_frame(conn)
@@ -142,13 +161,50 @@ class SignerServer:
                     resp = self._dispatch(payload)
                 except Exception as e:  # never kill the loop on one bad msg
                     logger.exception("signer dispatch failed")
-                    resp = _envelope(F_PING_RESP, _err_body(ERR_GENERIC, str(e)))
+                    # report in the response type matching the request so the
+                    # client surfaces the description instead of a field error
+                    try:
+                        field, _ = _decode_envelope(payload)
+                    except ValueError:
+                        field = F_PING_REQ
+                    resp_field = {
+                        F_SIGN_VOTE_REQ: F_SIGNED_VOTE_RESP,
+                        F_SIGN_PROPOSAL_REQ: F_SIGNED_PROPOSAL_RESP,
+                        F_PUBKEY_REQ: F_PUBKEY_RESP,
+                    }.get(field, F_PING_RESP)
+                    resp = _envelope(resp_field, self._err_resp(ERR_GENERIC, e))
                 try:
                     conn.sendall(resp)
                 except OSError:
                     return
 
+    def _authenticate(self, conn: socket.socket) -> bool:
+        """Challenge-response: the client must sign our nonce with a key on
+        the allowlist. Votes/sigs are public data, so the confidentiality of
+        a SecretConnection is not required — only oracle prevention."""
+        import os as _os
+
+        nonce = _os.urandom(32)
+        try:
+            conn.sendall(struct.pack(">I", len(nonce)) + nonce)
+            resp = _read_frame(conn)
+        except (ConnectionError, OSError, ValueError):
+            return False
+        # resp: pubkey(32) || signature(64)
+        if len(resp) != 96:
+            return False
+        pub_bytes, sig = resp[:32], resp[32:]
+        for key in self.authorized_keys:
+            if key.bytes() == pub_bytes and key.verify(b"privval-auth" + nonce, sig):
+                return True
+        logger.warning("privval client failed authentication")
+        return False
+
     def _dispatch(self, payload: bytes) -> bytes:
+        with self._lock:
+            return self._dispatch_locked(payload)
+
+    def _dispatch_locked(self, payload: bytes) -> bytes:
         field, body = _decode_envelope(payload)
         if field == F_PING_REQ:
             return _envelope(F_PING_RESP, b"")
@@ -201,19 +257,45 @@ class SignerServer:
 
 class SignerClient:
     """PrivValidator that signs via a remote SignerServer
-    (reference: privval/signer_client.go:16)."""
+    (reference: privval/signer_client.go:16).
 
-    def __init__(self, host: str, port: int, timeout: float = 5.0):
+    auth_key: node PrivKey used to answer the server's challenge when the
+    server runs with an authorized-keys allowlist.
+    dial_retry: keep retrying the initial dial for this many seconds (the
+    signer process may come up after the node — reference:
+    createAndStartPrivValidatorSocketClient retry loop)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0,
+                 auth_key=None, dial_retry: float = 10.0):
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.auth_key = auth_key
+        self.dial_retry = dial_retry
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._pub_key: Optional[PubKey] = None
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
-            self._sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
+            import time as _time
+
+            deadline = _time.monotonic() + self.dial_retry
+            while True:
+                try:
+                    self._sock = socket.create_connection(
+                        (self.host, self.port), timeout=self.timeout
+                    )
+                    break
+                except OSError:
+                    if _time.monotonic() >= deadline:
+                        raise
+                    _time.sleep(0.25)
+            if self.auth_key is not None:
+                nonce = _read_frame(self._sock)
+                sig = self.auth_key.sign(b"privval-auth" + nonce)
+                payload = self.auth_key.pub_key().bytes() + sig
+                self._sock.sendall(struct.pack(">I", len(payload)) + payload)
         return self._sock
 
     def close(self) -> None:
@@ -232,6 +314,11 @@ class SignerClient:
                     sock.sendall(_envelope(field, body))
                     payload = _read_frame(sock)
                     break
+                except ValueError:
+                    # framing violation: the stream is desynchronized —
+                    # never reuse this socket
+                    self.close()
+                    raise
                 except (ConnectionError, OSError):
                     self.close()
                     if attempt:

@@ -92,6 +92,60 @@ def test_sign_proposal_over_socket(signer):
     assert pv.get_pub_key().verify(prop.sign_bytes(CHAIN), signed.signature)
 
 
+def test_authenticated_signer_rejects_unauthorized_clients():
+    """With an allowlist, only clients holding an authorized key may sign
+    (closes the signing-oracle hole on non-loopback binds)."""
+    pv = FilePV(gen_ed25519(b"\x45" * 32))
+    node_key = gen_ed25519(b"\x46" * 32)
+    server = SignerServer(pv, CHAIN, authorized_keys=[node_key.pub_key()])
+    server.start()
+    try:
+        good = SignerClient("127.0.0.1", server.addr[1], auth_key=node_key)
+        assert good.sign_vote(CHAIN, make_vote(1)).signature
+        good.close()
+
+        # wrong key: connection is dropped before any request is served
+        bad = SignerClient(
+            "127.0.0.1", server.addr[1],
+            auth_key=gen_ed25519(b"\x47" * 32), dial_retry=0.1,
+        )
+        with pytest.raises((ConnectionError, OSError, ValueError)):
+            bad.sign_vote(CHAIN, make_vote(2, tag=b"x"))
+        bad.close()
+
+        # no auth key at all: the server's first frame is the nonce, which a
+        # naive client misreads; either way it cannot obtain a signature
+        naive = SignerClient("127.0.0.1", server.addr[1], dial_retry=0.1)
+        with pytest.raises(Exception):
+            naive.sign_vote(CHAIN, make_vote(3, tag=b"y"))
+        naive.close()
+    finally:
+        server.stop()
+
+
+def test_concurrent_connections_cannot_equivocate(signer):
+    """Two clients racing the same HRS with different blocks: exactly one
+    signature may be produced (FilePV access is serialized in the server)."""
+    import threading
+
+    pv, client = signer
+    other = SignerClient("127.0.0.1", client.port)
+    results = []
+
+    def sign(c, tag):
+        try:
+            results.append(("ok", c.sign_vote(CHAIN, make_vote(9, tag=tag)).signature))
+        except DoubleSignError as e:
+            results.append(("double", str(e)))
+
+    t1 = threading.Thread(target=sign, args=(client, b"AA"))
+    t2 = threading.Thread(target=sign, args=(other, b"BB"))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    other.close()
+    kinds = sorted(k for k, _ in results)
+    assert kinds == ["double", "ok"], results
+
+
 def test_node_signs_through_remote_signer(tmp_path):
     """A single-validator node drives consensus entirely through the socket
     signer (reference: node/node.go:658 createAndStartPrivValidatorSocketClient)."""
