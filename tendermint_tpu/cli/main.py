@@ -1,0 +1,325 @@
+"""Command-line interface (reference: cmd/tendermint/main.go:15-32 and
+cmd/tendermint/commands/*).
+
+Subcommands: init, start, testnet, show-node-id, show-validator,
+gen-validator, unsafe-reset-all, light, version.
+
+Run as `python -m tendermint_tpu.cli <cmd>` (module entry in cli/__main__.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import shutil
+import signal
+import sys
+import time
+
+from tendermint_tpu.config.config import Config
+from tendermint_tpu.config.toml import load_config, save_config
+
+VERSION = "0.2.0"
+
+logger = logging.getLogger("tendermint_tpu.cli")
+
+
+def default_home() -> str:
+    return os.environ.get("TMTPU_HOME", os.path.expanduser("~/.tendermint_tpu"))
+
+
+def _config_path(home: str) -> str:
+    return os.path.join(home, "config", "config.toml")
+
+
+def load_home(home: str) -> Config:
+    path = _config_path(home)
+    cfg = load_config(path) if os.path.exists(path) else Config()
+    cfg.root_dir = home
+    return cfg
+
+
+# ------------------------------------------------------------------ init
+
+
+def init_files(home: str, chain_id: str = "", seed: bytes | None = None,
+               overwrite: bool = False) -> dict:
+    """Create config dir tree + keys + genesis
+    (reference: cmd/tendermint/commands/init.go)."""
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    cfg = Config()
+    cfg.root_dir = home
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+
+    cfg_path = _config_path(home)
+    if overwrite or not os.path.exists(cfg_path):
+        save_config(cfg, cfg_path)
+
+    key_file = cfg.path(cfg.base.priv_validator_key_file)
+    state_file = cfg.path(cfg.base.priv_validator_state_file)
+    if overwrite or not os.path.exists(key_file):
+        pv = FilePV.generate(key_file, state_file, seed=seed)
+    else:
+        pv = FilePV.load(key_file, state_file)
+
+    node_key = NodeKey.load_or_gen(cfg.path(cfg.base.node_key_file))
+
+    gen_path = cfg.genesis_path()
+    if overwrite or not os.path.exists(gen_path):
+        gen = GenesisDoc(
+            chain_id=chain_id or f"test-chain-{os.urandom(3).hex()}",
+            genesis_time_ns=time.time_ns(),
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+        gen.validate_and_complete()
+        with open(gen_path, "w") as f:
+            f.write(gen.to_json())
+    return {
+        "home": home,
+        "node_id": node_key.id,
+        "validator_address": pv.get_pub_key().address().hex().upper(),
+    }
+
+
+# ------------------------------------------------------------------ start
+
+
+def run_node(home: str) -> None:
+    """reference: cmd/tendermint/commands/run_node.go:100."""
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    cfg = load_home(home)
+    logging.basicConfig(
+        level=getattr(logging, cfg.base.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    with open(cfg.genesis_path()) as f:
+        gen = GenesisDoc.from_json(f.read())
+    pv = None
+    if not cfg.base.priv_validator_addr:
+        pv = FilePV.load(
+            cfg.path(cfg.base.priv_validator_key_file),
+            cfg.path(cfg.base.priv_validator_state_file),
+        )
+    node = Node(cfg, gen, priv_validator=pv)
+
+    async def main():
+        stop = asyncio.Event()
+        loop = asyncio.get_event_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        await node.start()
+        print(f"node {node.node_key.id if node.node_key else ''} started; "
+              f"chain {gen.chain_id}; ^C to stop", flush=True)
+        await stop.wait()
+        await node.stop()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------- testnet
+
+
+def make_testnet(output_dir: str, n_validators: int, chain_id: str = "",
+                 starting_port: int = 26656, populate_persistent_peers: bool = True) -> list:
+    """N validator config dirs sharing one genesis
+    (reference: cmd/tendermint/commands/testnet.go)."""
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    chain_id = chain_id or f"chain-{os.urandom(3).hex()}"
+    nodes = []
+    for i in range(n_validators):
+        home = os.path.join(output_dir, f"node{i}")
+        cfg = Config()
+        cfg.root_dir = home
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        pv = FilePV.generate(
+            cfg.path(cfg.base.priv_validator_key_file),
+            cfg.path(cfg.base.priv_validator_state_file),
+        )
+        node_key = NodeKey.load_or_gen(cfg.path(cfg.base.node_key_file))
+        nodes.append((home, cfg, pv, node_key, starting_port + 2 * i))
+
+    gen = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=time.time_ns(),
+        validators=[
+            GenesisValidator(pv.get_pub_key(), 10, name=f"node{i}")
+            for i, (_, _, pv, _, _) in enumerate(nodes)
+        ],
+    )
+    gen.validate_and_complete()
+    gen_json = gen.to_json()
+
+    peers = ",".join(
+        f"{nk.id}@127.0.0.1:{port}" for (_, _, _, nk, port) in nodes
+    )
+    out = []
+    for i, (home, cfg, pv, nk, port) in enumerate(nodes):
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{port}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{port + 1}"
+        if populate_persistent_peers:
+            cfg.p2p.persistent_peers = ",".join(
+                p for p in peers.split(",") if not p.startswith(nk.id)
+            )
+        save_config(cfg, _config_path(home))
+        with open(cfg.genesis_path(), "w") as f:
+            f.write(gen_json)
+        out.append({"home": home, "node_id": nk.id, "p2p": cfg.p2p.laddr, "rpc": cfg.rpc.laddr})
+    return out
+
+
+# ------------------------------------------------------------------ light
+
+
+def run_light(chain_id: str, primary: str, witnesses: list, trust_height: int,
+              trust_hash: str, home: str, height: int | None) -> None:
+    """Verify a header via the light client against live RPC endpoints
+    (reference: cmd/tendermint/commands/lite.go `tendermint light`)."""
+    from tendermint_tpu.libs.kvdb import SQLiteDB
+    from tendermint_tpu.light import Client, HTTPProvider, LightStore, TrustOptions
+    from tendermint_tpu.rpc.client import HTTPClient
+    from tendermint_tpu.types.basic import NANOS
+
+    async def main():
+        clients = [HTTPClient(primary)] + [HTTPClient(w) for w in witnesses]
+        providers = [HTTPProvider(chain_id, c) for c in clients]
+        os.makedirs(home, exist_ok=True)
+        store = LightStore(SQLiteDB(os.path.join(home, "light.db")))
+        lc = Client(
+            chain_id,
+            TrustOptions(7 * 24 * 3600 * NANOS, trust_height, bytes.fromhex(trust_hash)),
+            providers[0],
+            providers[1:],
+            store,
+        )
+        try:
+            await lc.initialize()
+            lb = (
+                await lc.verify_light_block_at_height(height)
+                if height
+                else await lc.update()
+            )
+            if lb is None:
+                lb = store.latest_light_block()
+            print(json.dumps({
+                "height": lb.height,
+                "hash": lb.hash().hex().upper(),
+                "app_hash": lb.header.app_hash.hex().upper(),
+                "trusted_heights": store.heights()[-10:],
+            }))
+        finally:
+            for c in clients:
+                await c.close()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tendermint-tpu", description=__doc__)
+    p.add_argument("--home", default=default_home(), help="node home directory")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("init", help="create config dir, keys, and genesis")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--overwrite", action="store_true")
+
+    sub.add_parser("start", help="run the node")
+
+    sp = sub.add_parser("testnet", help="generate N validator config dirs")
+    sp.add_argument("--v", type=int, default=4, help="number of validators")
+    sp.add_argument("--output-dir", default="./mytestnet")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-port", type=int, default=26656)
+
+    sub.add_parser("show-node-id", help="print the p2p node id")
+    sub.add_parser("show-validator", help="print the validator pubkey")
+    sub.add_parser("gen-validator", help="print a fresh validator key (JSON)")
+    sub.add_parser("unsafe-reset-all", help="wipe data dir, keep config + keys")
+    sub.add_parser("version", help="print version")
+
+    sp = sub.add_parser("light", help="light client: verify headers over RPC")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", required=True, help="primary RPC URL")
+    sp.add_argument("--witness", action="append", default=[], help="witness RPC URL")
+    sp.add_argument("--trust-height", type=int, required=True)
+    sp.add_argument("--trust-hash", required=True)
+    sp.add_argument("--height", type=int, default=None)
+
+    args = p.parse_args(argv)
+
+    if args.cmd == "init":
+        info = init_files(args.home, args.chain_id, overwrite=args.overwrite)
+        print(json.dumps(info))
+    elif args.cmd == "start":
+        run_node(args.home)
+    elif args.cmd == "testnet":
+        out = make_testnet(args.output_dir, args.v, args.chain_id, args.starting_port)
+        print(json.dumps(out))
+    elif args.cmd == "show-node-id":
+        from tendermint_tpu.p2p.key import NodeKey
+
+        cfg = load_home(args.home)
+        print(NodeKey.load_or_gen(cfg.path(cfg.base.node_key_file)).id)
+    elif args.cmd == "show-validator":
+        from tendermint_tpu.privval.file_pv import FilePV
+
+        cfg = load_home(args.home)
+        pv = FilePV.load(
+            cfg.path(cfg.base.priv_validator_key_file),
+            cfg.path(cfg.base.priv_validator_state_file),
+        )
+        pub = pv.get_pub_key()
+        print(json.dumps({"type": pub.type_name(), "value": pub.bytes().hex()}))
+    elif args.cmd == "gen-validator":
+        from tendermint_tpu.crypto.keys import gen_ed25519
+
+        priv = gen_ed25519()
+        pub = priv.pub_key()
+        print(json.dumps({
+            "address": pub.address().hex().upper(),
+            "pub_key": pub.bytes().hex(),
+            "priv_key": priv.bytes().hex(),
+        }))
+    elif args.cmd == "unsafe-reset-all":
+        cfg = load_home(args.home)
+        data_dir = cfg.path("data")
+        if os.path.isdir(data_dir):
+            shutil.rmtree(data_dir)
+        os.makedirs(data_dir, exist_ok=True)
+        # reset the privval sign state but KEEP the key
+        state_file = cfg.path(cfg.base.priv_validator_state_file)
+        if os.path.exists(state_file):
+            os.unlink(state_file)
+        print(json.dumps({"reset": args.home}))
+    elif args.cmd == "version":
+        print(VERSION)
+    elif args.cmd == "light":
+        run_light(
+            args.chain_id, args.primary, args.witness,
+            args.trust_height, args.trust_hash, args.home, args.height,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -187,3 +187,106 @@ class SecretConnection:
             self._writer.close()
         except Exception:
             pass
+
+
+class SyncSecretConnection:
+    """Blocking-socket variant of SecretConnection — same STS construction,
+    same framing — for threaded endpoints (the privval remote signer). One
+    instance is NOT thread-safe; serialize calls externally."""
+
+    def __init__(self, sock, send_aead, recv_aead, remote_pubkey):
+        self._sock = sock
+        self._send = send_aead
+        self._recv = recv_aead
+        self._send_nonce = _Nonce()
+        self._recv_nonce = _Nonce()
+        self.remote_pubkey = remote_pubkey
+        self._recv_buf = b""
+
+    @classmethod
+    def upgrade(cls, sock, priv_key: PrivKey) -> "SyncSecretConnection":
+        eph_priv = X25519PrivateKey.generate()
+        eph_pub = eph_priv.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        sock.sendall(struct.pack(">I", len(eph_pub)) + eph_pub)
+        (ln,) = struct.unpack(">I", _recv_exact(sock, 4))
+        if ln != 32:
+            raise HandshakeError("bad ephemeral key length")
+        remote_eph = _recv_exact(sock, 32)
+
+        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+        low_is_us = eph_pub < remote_eph
+        lo, hi = (eph_pub, remote_eph) if low_is_us else (remote_eph, eph_pub)
+        recv_secret, send_secret, challenge_lo = _hkdf(shared + lo + hi)
+        if low_is_us:
+            send_key, recv_key = send_secret, recv_secret
+        else:
+            send_key, recv_key = recv_secret, send_secret
+        transcript = hashlib.sha256(
+            b"TMTPU_SECRET_CONNECTION_TRANSCRIPT" + lo + hi + challenge_lo
+        ).digest()
+
+        conn = cls(sock, ChaCha20Poly1305(send_key), ChaCha20Poly1305(recv_key), None)
+        local_pub = priv_key.pub_key()
+        conn.write_msg(local_pub.bytes() + priv_key.sign(transcript))
+        auth = conn.read_msg()
+        if len(auth) != 32 + 64:
+            raise HandshakeError("bad auth message size")
+        remote_pub = Ed25519PubKey(auth[:32])
+        if not remote_pub.verify(transcript, auth[32:]):
+            raise HandshakeError("challenge signature verification failed")
+        conn.remote_pubkey = remote_pub
+        return conn
+
+    def write(self, data: bytes) -> None:
+        off = 0
+        out = bytearray()
+        while True:
+            chunk = data[off : off + DATA_MAX_SIZE]
+            frame = struct.pack("<I", len(chunk)) + chunk
+            frame += b"\x00" * (TOTAL_FRAME_SIZE - len(frame))
+            out += self._send.encrypt(self._send_nonce.use(), bytes(frame), None)
+            off += DATA_MAX_SIZE
+            if off >= len(data):
+                break
+        self._sock.sendall(bytes(out))
+
+    def read(self, n: int) -> bytes:
+        while len(self._recv_buf) < n:
+            sealed = _recv_exact(self._sock, SEALED_FRAME_SIZE)
+            try:
+                frame = self._recv.decrypt(self._recv_nonce.use(), sealed, None)
+            except InvalidTag as e:
+                raise HandshakeError("frame decryption failed") from e
+            (ln,) = struct.unpack("<I", frame[:DATA_LEN_SIZE])
+            if ln > DATA_MAX_SIZE:
+                raise HandshakeError("frame length too large")
+            self._recv_buf += frame[DATA_LEN_SIZE : DATA_LEN_SIZE + ln]
+        out, self._recv_buf = self._recv_buf[:n], self._recv_buf[n:]
+        return out
+
+    def write_msg(self, msg: bytes) -> None:
+        self.write(struct.pack(">I", len(msg)) + msg)
+
+    def read_msg(self, max_size: int = 1 << 22) -> bytes:
+        (ln,) = struct.unpack(">I", self.read(4))
+        if ln > max_size:
+            raise HandshakeError("message too large")
+        return self.read(ln)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise HandshakeError("connection closed during secret handshake")
+        buf += chunk
+    return buf

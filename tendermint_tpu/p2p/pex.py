@@ -231,12 +231,19 @@ class AddrBook:
         except (OSError, json.JSONDecodeError):
             logger.warning("could not load addrbook %s", self.file_path)
             return
-        self.key = bytes.fromhex(data.get("key", "")) or self.key
+        try:
+            self.key = bytes.fromhex(data.get("key", "")) or self.key
+        except ValueError:
+            logger.warning("addrbook key corrupt; regenerating")
         for o in data.get("addrs", []):
-            ka = KnownAddress.from_json(o)
-            if ka.id and ka.id not in self._addrs:
-                self._addrs[ka.id] = ka
-                self._place(ka)
+            # a single corrupt entry must not prevent node startup
+            try:
+                ka = KnownAddress.from_json(o)
+                if ka.id and ka.id not in self._addrs:
+                    self._addrs[ka.id] = ka
+                    self._place(ka)
+            except (KeyError, ValueError, TypeError) as e:
+                logger.warning("skipping corrupt addrbook entry %r: %s", o, e)
 
 
 # ---------------------------------------------------------------- wire msgs
@@ -296,6 +303,7 @@ class PexReactor(Reactor):
         self.max_outbound = max_outbound
         self.seed_mode = seed_mode
         self._last_request: Dict[str, float] = {}  # peer id -> ts (anti-spam)
+        self._last_sent: Dict[str, float] = {}  # our own request cadence
         self._requested: set = set()  # peers we asked (only they may reply)
         self._task: Optional[asyncio.Task] = None
 
@@ -326,6 +334,7 @@ class PexReactor(Reactor):
     async def remove_peer(self, peer, reason) -> None:
         self._requested.discard(peer.id)
         self._last_request.pop(peer.id, None)
+        self._last_sent.pop(peer.id, None)
 
     # -- receive ------------------------------------------------------------
 
@@ -337,12 +346,20 @@ class PexReactor(Reactor):
             return
         if addrs is None:  # PexRequest
             now = time.monotonic()
-            last = self._last_request.get(peer.id, 0.0)
-            if now - last < MIN_REQUEST_INTERVAL:
+            last = self._last_request.get(peer.id)
+            # the FIRST request after connect is always allowed; after that
+            # anything under the interval is a flood (reference:
+            # pex_reactor.go receiveRequest lastReceivedRequests)
+            if last is not None and now - last < MIN_REQUEST_INTERVAL:
                 await self.switch.stop_peer_for_error(peer, "pex request flood")
                 return
             self._last_request[peer.id] = now
             await peer.send(PEX_CHANNEL, encode_pex_addrs(self.book.get_selection()))
+            if self.seed_mode:
+                # seeds hand out addresses and hang up to free slots for
+                # other crawlers (reference: pex_reactor.go:308 seed flow)
+                await asyncio.sleep(0.1)
+                await self.switch.stop_peer_for_error(peer, "seed: served addrs")
         else:  # PexAddrs
             # unsolicited address dumps are an attack vector
             # (reference: pex_reactor.go:260 ReceiveAddrs requestsSent check)
@@ -359,9 +376,14 @@ class PexReactor(Reactor):
                     self.book.add_address(a, src=peer.id)
 
     async def _request_addrs(self, peer) -> None:
-        """reference: pex_reactor.go:240 RequestAddrs."""
+        """reference: pex_reactor.go:240 RequestAddrs. Rate-limited on OUR
+        side too, so our own cadence never trips the peer's flood guard."""
+        now = time.monotonic()
         if peer.id in self._requested:
             return
+        if now - self._last_sent.get(peer.id, -1e9) < MIN_REQUEST_INTERVAL * 1.5:
+            return
+        self._last_sent[peer.id] = now
         self._requested.add(peer.id)
         await peer.send(PEX_CHANNEL, encode_pex_request())
 

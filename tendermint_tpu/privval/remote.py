@@ -9,6 +9,13 @@ deliberately BLOCKING (the reference's SignerClient is too): consensus signs
 at most one vote/proposal at a time, and the loopback round-trip is far below
 the consensus step timeouts. The server runs in its own thread (standing in
 for the external signer process, e.g. a tmkms-style HSM host).
+
+Authentication: when the server has an authorized-keys allowlist, every
+connection is upgraded to a SyncSecretConnection (X25519+HKDF+
+ChaCha20-Poly1305, ed25519 transcript signatures — the same STS construction
+the reference wraps tcp:// privval in). The session is MAC'd end to end, so
+an on-path attacker can neither splice the handshake nor inject sign
+requests into an authenticated stream.
 """
 
 from __future__ import annotations
@@ -74,22 +81,51 @@ def _envelope(field: int, body: bytes) -> bytes:
     return struct.pack(">I", len(payload)) + payload
 
 
-def _read_frame(sock: socket.socket) -> bytes:
-    hdr = _read_exact(sock, 4)
+class _RawIO:
+    """Plain-socket transport."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def sendall(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    def recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("privval connection closed")
+            buf += chunk
+        return buf
+
+
+class _SecretIO:
+    """SyncSecretConnection transport (authenticated + MAC'd). Stream-level
+    failures surface as ConnectionError so the caller's reconnect logic
+    treats them like any dropped socket."""
+
+    def __init__(self, sconn):
+        self.sconn = sconn
+
+    def sendall(self, data: bytes) -> None:
+        self.sconn.write(data)
+
+    def recv_exact(self, n: int) -> bytes:
+        from tendermint_tpu.p2p.conn.secret_connection import HandshakeError
+
+        try:
+            return self.sconn.read(n)
+        except HandshakeError as e:
+            raise ConnectionError(str(e)) from e
+
+
+def _read_frame(io) -> bytes:
+    hdr = io.recv_exact(4)
     (n,) = struct.unpack(">I", hdr)
     if n > 1 << 20:
         raise ValueError(f"privval frame too large: {n}")
-    return _read_exact(sock, n)
-
-
-def _read_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("privval connection closed")
-        buf += chunk
-    return buf
+    return io.recv_exact(n)
 
 
 def _decode_envelope(payload: bytes):
@@ -108,16 +144,22 @@ class SignerServer:
     check-then-act, so concurrent connections must never race it.
 
     authorized_keys: optional list of client PubKeys. When set, each
-    connection must pass a challenge-response (sign a server nonce with its
-    node key) before any request is served — this closes the signing-oracle
-    hole when the socket is reachable beyond loopback (the reference uses a
-    SecretConnection for the same purpose)."""
+    connection is upgraded to a SyncSecretConnection and the client's
+    transcript-signing key must be on the allowlist — this closes the
+    signing-oracle hole when the socket is reachable beyond loopback.
+    identity_key: the server's ed25519 identity for the handshake (NOT the
+    validator key; generated if omitted)."""
 
     def __init__(self, pv: FilePV, chain_id: str, host: str = "127.0.0.1", port: int = 0,
-                 authorized_keys=None):
+                 authorized_keys=None, identity_key=None):
         self.pv = pv
         self.chain_id = chain_id
         self.authorized_keys = list(authorized_keys or [])
+        if identity_key is None:
+            from tendermint_tpu.crypto.keys import gen_ed25519
+
+            identity_key = gen_ed25519()
+        self.identity_key = identity_key
         if not self.authorized_keys and host not in ("127.0.0.1", "::1", "localhost"):
             logger.warning(
                 "privval signer listening on %s WITHOUT client authentication — "
@@ -150,12 +192,15 @@ class SignerServer:
 
     def _handle(self, conn: socket.socket) -> None:
         with conn:
-            if self.authorized_keys and not self._authenticate(conn):
+            io = self._upgrade(conn)
+            if io is None:
                 return
             while not self._stop.is_set():
                 try:
-                    payload = _read_frame(conn)
-                except (ConnectionError, OSError, ValueError):
+                    payload = _read_frame(io)
+                except (ConnectionError, OSError, ValueError) as e:
+                    if not isinstance(e, ConnectionError):
+                        logger.info("privval connection error: %s", e)
                     return
                 try:
                     resp = self._dispatch(payload)
@@ -174,31 +219,30 @@ class SignerServer:
                     }.get(field, F_PING_RESP)
                     resp = _envelope(resp_field, self._err_resp(ERR_GENERIC, e))
                 try:
-                    conn.sendall(resp)
+                    io.sendall(resp)
                 except OSError:
                     return
 
-    def _authenticate(self, conn: socket.socket) -> bool:
-        """Challenge-response: the client must sign our nonce with a key on
-        the allowlist. Votes/sigs are public data, so the confidentiality of
-        a SecretConnection is not required — only oracle prevention."""
-        import os as _os
+    def _upgrade(self, conn: socket.socket):
+        """Plain transport, or a SecretConnection whose remote key must be on
+        the allowlist (reference: tcp:// privval wraps in SecretConnection)."""
+        if not self.authorized_keys:
+            return _RawIO(conn)
+        from tendermint_tpu.p2p.conn.secret_connection import (
+            HandshakeError,
+            SyncSecretConnection,
+        )
 
-        nonce = _os.urandom(32)
         try:
-            conn.sendall(struct.pack(">I", len(nonce)) + nonce)
-            resp = _read_frame(conn)
-        except (ConnectionError, OSError, ValueError):
-            return False
-        # resp: pubkey(32) || signature(64)
-        if len(resp) != 96:
-            return False
-        pub_bytes, sig = resp[:32], resp[32:]
-        for key in self.authorized_keys:
-            if key.bytes() == pub_bytes and key.verify(b"privval-auth" + nonce, sig):
-                return True
-        logger.warning("privval client failed authentication")
-        return False
+            sconn = SyncSecretConnection.upgrade(conn, self.identity_key)
+        except (HandshakeError, ConnectionError, OSError) as e:
+            logger.warning("privval secret handshake failed: %s", e)
+            return None
+        allowed = {k.bytes() for k in self.authorized_keys}
+        if sconn.remote_pubkey.bytes() not in allowed:
+            logger.warning("privval client key not on the allowlist")
+            return None
+        return _SecretIO(sconn)
 
     def _dispatch(self, payload: bytes) -> bytes:
         with self._lock:
@@ -259,28 +303,34 @@ class SignerClient:
     """PrivValidator that signs via a remote SignerServer
     (reference: privval/signer_client.go:16).
 
-    auth_key: node PrivKey used to answer the server's challenge when the
-    server runs with an authorized-keys allowlist.
-    dial_retry: keep retrying the initial dial for this many seconds (the
+    auth_key: node PrivKey identifying this client in the secret-connection
+    handshake, required when the server runs an authorized-keys allowlist.
+    server_pubkey: optional expected server identity (pinning).
+    dial_retry: keep retrying the INITIAL dial for this many seconds (the
     signer process may come up after the node — reference:
-    createAndStartPrivValidatorSocketClient retry loop)."""
+    createAndStartPrivValidatorSocketClient retry loop). Reconnects after a
+    broken pipe are single-shot so a dead signer fails fast."""
 
     def __init__(self, host: str, port: int, timeout: float = 5.0,
-                 auth_key=None, dial_retry: float = 10.0):
+                 auth_key=None, server_pubkey=None, dial_retry: float = 10.0):
         self.host = host
         self.port = port
         self.timeout = timeout
         self.auth_key = auth_key
+        self.server_pubkey = server_pubkey
         self.dial_retry = dial_retry
+        self._io = None
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._pub_key: Optional[PubKey] = None
+        self._connected_once = False
 
-    def _connect(self) -> socket.socket:
-        if self._sock is None:
+    def _connect(self):
+        if self._io is None:
             import time as _time
 
-            deadline = _time.monotonic() + self.dial_retry
+            retry_window = 0.0 if self._connected_once else self.dial_retry
+            deadline = _time.monotonic() + retry_window
             while True:
                 try:
                     self._sock = socket.create_connection(
@@ -291,12 +341,28 @@ class SignerClient:
                     if _time.monotonic() >= deadline:
                         raise
                     _time.sleep(0.25)
+            self._connected_once = True
             if self.auth_key is not None:
-                nonce = _read_frame(self._sock)
-                sig = self.auth_key.sign(b"privval-auth" + nonce)
-                payload = self.auth_key.pub_key().bytes() + sig
-                self._sock.sendall(struct.pack(">I", len(payload)) + payload)
-        return self._sock
+                from tendermint_tpu.p2p.conn.secret_connection import (
+                    HandshakeError,
+                    SyncSecretConnection,
+                )
+
+                try:
+                    sconn = SyncSecretConnection.upgrade(self._sock, self.auth_key)
+                except HandshakeError as e:
+                    self.close()
+                    raise ConnectionError(f"privval secret handshake failed: {e}") from e
+                if (
+                    self.server_pubkey is not None
+                    and sconn.remote_pubkey.bytes() != self.server_pubkey.bytes()
+                ):
+                    self.close()
+                    raise ConnectionError("privval server identity mismatch")
+                self._io = _SecretIO(sconn)
+            else:
+                self._io = _RawIO(self._sock)
+        return self._io
 
     def close(self) -> None:
         if self._sock is not None:
@@ -305,24 +371,29 @@ class SignerClient:
             except OSError:
                 pass
             self._sock = None
+        self._io = None
 
     def _call(self, field: int, body: bytes, want: int) -> bytes:
         with self._lock:
             for attempt in (0, 1):  # one reconnect on a broken pipe
                 try:
-                    sock = self._connect()
-                    sock.sendall(_envelope(field, body))
-                    payload = _read_frame(sock)
+                    io = self._connect()
+                    io.sendall(_envelope(field, body))
+                    payload = _read_frame(io)
                     break
                 except ValueError:
-                    # framing violation: the stream is desynchronized —
-                    # never reuse this socket
+                    # framing/MAC violation (HandshakeError subclasses
+                    # ValueError-adjacent paths raise here too): the stream is
+                    # desynchronized — never reuse this socket
                     self.close()
                     raise
                 except (ConnectionError, OSError):
                     self.close()
                     if attempt:
                         raise
+                except Exception:
+                    self.close()
+                    raise
         got, resp = _decode_envelope(payload)
         if got != want:
             raise RemoteSignerError(ERR_GENERIC, f"unexpected response field {got}, want {want}")

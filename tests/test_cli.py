@@ -1,0 +1,169 @@
+"""CLI + TOML config (reference test models: cmd/tendermint/commands tests,
+config/toml_test.go)."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.cli.main import init_files, main, make_testnet
+from tendermint_tpu.config.config import Config
+from tendermint_tpu.config.toml import dumps, load_config, loads, save_config
+
+
+def test_toml_roundtrip_preserves_all_fields(tmp_path):
+    cfg = Config()
+    cfg.base.moniker = "alice"
+    cfg.base.fast_sync = False
+    cfg.rpc.laddr = "tcp://0.0.0.0:36657"
+    cfg.p2p.persistent_peers = "aa@1.2.3.4:26656,bb@5.6.7.8:26656"
+    cfg.p2p.pex = False
+    cfg.consensus.timeout_commit = 2.5
+    cfg.statesync.enable = True
+    cfg.statesync.rpc_servers = ["http://a:26657", "http://b:26657"]
+    cfg.statesync.trust_height = 42
+    cfg.statesync.trust_hash = "ab" * 32
+
+    path = str(tmp_path / "config.toml")
+    save_config(cfg, path)
+    cfg2 = load_config(path)
+
+    assert cfg2.base.moniker == "alice"
+    assert cfg2.base.fast_sync is False
+    assert cfg2.rpc.laddr == "tcp://0.0.0.0:36657"
+    assert cfg2.p2p.persistent_peers == cfg.p2p.persistent_peers
+    assert cfg2.p2p.pex is False
+    assert cfg2.consensus.timeout_commit == 2.5
+    assert cfg2.statesync.enable is True
+    assert cfg2.statesync.rpc_servers == cfg.statesync.rpc_servers
+    assert cfg2.statesync.trust_height == 42
+
+
+def test_toml_unknown_keys_ignored_and_defaults_kept():
+    cfg = loads('moniker = "m"\nbogus_key = 1\n[rpc]\nladdr = "tcp://h:1"\nnope = true\n[unknown_section]\nx = 2\n')
+    assert cfg.base.moniker == "m"
+    assert cfg.rpc.laddr == "tcp://h:1"
+    # untouched defaults survive
+    assert cfg.p2p.pex is True
+    assert cfg.consensus.timeout_commit == 1.0
+
+
+def test_init_creates_tree_and_is_idempotent(tmp_path):
+    home = str(tmp_path / "node")
+    info = init_files(home, chain_id="cli-chain")
+    for rel in (
+        "config/config.toml",
+        "config/genesis.json",
+        "config/priv_validator_key.json",
+        "config/node_key.json",
+        "data",
+    ):
+        assert os.path.exists(os.path.join(home, rel)), rel
+    # second init keeps the same identity
+    info2 = init_files(home, chain_id="other")
+    assert info2["node_id"] == info["node_id"]
+    assert info2["validator_address"] == info["validator_address"]
+    gen = json.load(open(os.path.join(home, "config/genesis.json")))
+    assert gen["chain_id"] == "cli-chain"  # not overwritten
+
+
+def test_testnet_generates_wired_configs(tmp_path):
+    out = make_testnet(str(tmp_path / "net"), 4, chain_id="net-chain", starting_port=30000)
+    assert len(out) == 4
+    genesis_files = set()
+    for i, node in enumerate(out):
+        cfg = load_config(os.path.join(node["home"], "config", "config.toml"))
+        # every node lists the other three as persistent peers
+        peers = [p for p in cfg.p2p.persistent_peers.split(",") if p]
+        assert len(peers) == 3
+        assert all(not p.startswith(node["node_id"]) for p in peers)
+        genesis_files.add(open(os.path.join(node["home"], "config", "genesis.json")).read())
+    assert len(genesis_files) == 1  # identical genesis everywhere
+    gen = json.loads(next(iter(genesis_files)))
+    assert len(gen["validators"]) == 4
+
+
+def test_cli_entrypoints_run(tmp_path, capsys):
+    home = str(tmp_path / "h")
+    assert main(["--home", home, "init", "--chain-id", "x"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["node_id"]
+
+    assert main(["--home", home, "show-node-id"]) == 0
+    assert capsys.readouterr().out.strip() == out["node_id"]
+
+    assert main(["--home", home, "show-validator"]) == 0
+    v = json.loads(capsys.readouterr().out)
+    assert v["type"] == "ed25519"
+
+    assert main(["--home", home, "gen-validator"]) == 0
+    g = json.loads(capsys.readouterr().out)
+    assert len(bytes.fromhex(g["priv_key"])) == 32
+
+    assert main(["--home", home, "version"]) == 0
+    capsys.readouterr()
+
+    # unsafe-reset-all wipes data but keeps keys
+    datafile = os.path.join(home, "data", "junk")
+    open(datafile, "w").write("x")
+    assert main(["--home", home, "unsafe-reset-all"]) == 0
+    capsys.readouterr()
+    assert not os.path.exists(datafile)
+    assert os.path.exists(os.path.join(home, "config", "priv_validator_key.json"))
+
+
+def test_two_node_localnet_from_generated_configs(tmp_path):
+    """`testnet` output boots a real 2-validator net that commits blocks —
+    the reference's two-command localnet story
+    (reference: docs 'Deploy a Testnet' + commands/testnet.go)."""
+    from tendermint_tpu.cli.main import load_home
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    import socket as s
+
+    ports = []
+    for _ in range(2):
+        sock = s.socket()
+        sock.bind(("127.0.0.1", 0))
+        ports.append(sock.getsockname()[1])
+        sock.close()
+
+    out = make_testnet(str(tmp_path / "net"), 2, chain_id="localnet", starting_port=ports[0])
+    # rewrite the second node's ports to the second free port to avoid clashes
+    # (make_testnet allocates sequentially from starting_port)
+
+    async def run():
+        nodes = []
+        for entry in out:
+            cfg = load_home(entry["home"])
+            cfg.base.db_backend = "memdb"
+            cfg.rpc.laddr = ""
+            # fast test timeouts
+            cfg.consensus.timeout_propose = 0.4
+            cfg.consensus.timeout_prevote = 0.2
+            cfg.consensus.timeout_precommit = 0.2
+            cfg.consensus.timeout_commit = 0.1
+            with open(cfg.genesis_path()) as f:
+                gen = GenesisDoc.from_json(f.read())
+            pv = FilePV.load(
+                cfg.path(cfg.base.priv_validator_key_file),
+                cfg.path(cfg.base.priv_validator_state_file),
+            )
+            nodes.append(Node(cfg, gen, priv_validator=pv))
+        try:
+            for n in nodes:
+                await n.start()
+            for n in nodes:
+                await n.wait_for_height(3, timeout=90)
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    asyncio.run(run())

@@ -93,18 +93,26 @@ def test_sign_proposal_over_socket(signer):
 
 
 def test_authenticated_signer_rejects_unauthorized_clients():
-    """With an allowlist, only clients holding an authorized key may sign
-    (closes the signing-oracle hole on non-loopback binds)."""
+    """With an allowlist, the connection upgrades to a secret channel and
+    only clients holding an authorized key may sign (closes the
+    signing-oracle hole on non-loopback binds)."""
     pv = FilePV(gen_ed25519(b"\x45" * 32))
     node_key = gen_ed25519(b"\x46" * 32)
-    server = SignerServer(pv, CHAIN, authorized_keys=[node_key.pub_key()])
+    identity = gen_ed25519(b"\x48" * 32)
+    server = SignerServer(
+        pv, CHAIN, authorized_keys=[node_key.pub_key()], identity_key=identity
+    )
     server.start()
     try:
-        good = SignerClient("127.0.0.1", server.addr[1], auth_key=node_key)
+        # pinned server identity + authorized client key: works
+        good = SignerClient(
+            "127.0.0.1", server.addr[1],
+            auth_key=node_key, server_pubkey=identity.pub_key(),
+        )
         assert good.sign_vote(CHAIN, make_vote(1)).signature
         good.close()
 
-        # wrong key: connection is dropped before any request is served
+        # key not on the allowlist: handshake completes but serving refuses
         bad = SignerClient(
             "127.0.0.1", server.addr[1],
             auth_key=gen_ed25519(b"\x47" * 32), dial_retry=0.1,
@@ -113,12 +121,21 @@ def test_authenticated_signer_rejects_unauthorized_clients():
             bad.sign_vote(CHAIN, make_vote(2, tag=b"x"))
         bad.close()
 
-        # no auth key at all: the server's first frame is the nonce, which a
-        # naive client misreads; either way it cannot obtain a signature
+        # plaintext client against a secured server cannot obtain a signature
         naive = SignerClient("127.0.0.1", server.addr[1], dial_retry=0.1)
         with pytest.raises(Exception):
             naive.sign_vote(CHAIN, make_vote(3, tag=b"y"))
         naive.close()
+
+        # wrong pinned server identity is rejected client-side
+        mitm = SignerClient(
+            "127.0.0.1", server.addr[1],
+            auth_key=node_key, server_pubkey=gen_ed25519(b"\x49" * 32).pub_key(),
+            dial_retry=0.1,
+        )
+        with pytest.raises(ConnectionError):
+            mitm.sign_vote(CHAIN, make_vote(4, tag=b"z"))
+        mitm.close()
     finally:
         server.stop()
 
