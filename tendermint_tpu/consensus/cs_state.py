@@ -91,8 +91,10 @@ class ConsensusState:
         wal: WAL,
         event_bus: Optional[EventBus] = None,
         priv_validator=None,
+        metrics=None,
     ):
         self.config = config
+        self.metrics = metrics
         self.block_exec = block_exec
         self.block_store = block_store
         self.tx_notifier = tx_notifier
@@ -770,10 +772,29 @@ class ConsensusState:
             raise RuntimeError("expected ProposalBlockParts header to be commit header")
         if block.hash() != block_id.hash:
             raise RuntimeError("cannot finalize commit: proposal block does not hash to commit hash")
+        _tv0 = time.perf_counter()
         self.block_exec.validate_block(self.state, block)
+        _tv1 = time.perf_counter()
 
         logger.info("finalizing commit of block %d txs=%d hash=%s",
                     block.header.height, len(block.txs), block.hash().hex()[:12])
+        if self.metrics is not None:
+            m = self.metrics
+            m.commit_verify_seconds.observe(_tv1 - _tv0)
+            m.num_txs.set(len(block.txs))
+            m.total_txs.inc(len(block.txs))
+            m.block_size_bytes.set(block_parts.byte_size)
+            m.rounds.set(rs.round)
+            vals = rs.validators
+            m.validators.set(vals.size())
+            m.validators_power.set(vals.total_voting_power())
+            missing = sum(1 for cs_ in block.last_commit.signatures if not cs_.for_block())
+            m.missing_validators.set(missing)
+            m.byzantine_validators.set(len(block.evidence))
+            if self.state.last_block_height > 0:
+                m.block_interval_seconds.observe(
+                    max(0.0, (block.header.time_ns - self.state.last_block_time_ns) / 1e9)
+                )
         fail.fail_point("cs_before_save_block")
         if self.block_store.height < block.header.height:
             seen_commit = precommits.make_commit()
@@ -792,6 +813,8 @@ class ConsensusState:
         fail.fail_point("cs_after_apply_block")
 
         self._update_to_state(new_state)
+        if self.metrics is not None:
+            self.metrics.height.set(new_state.last_block_height)
         if self.priv_validator is not None:
             self.priv_validator_pub_key = self.priv_validator.get_pub_key()
         self._schedule_round0()

@@ -1,0 +1,283 @@
+"""Minimal Prometheus-style metrics: counters/gauges/histograms with labels
+and text exposition — no external dependency.
+
+reference: the per-service metrics.go files (consensus/metrics.go:28,
+p2p/metrics.go, mempool/metrics.go, state/metrics.go) and the go-kit
+prometheus provider wired in node/node.go:106-121.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+NAMESPACE = "tendermint"
+
+
+def _fmt_labels(label_names: Sequence[str], label_values: Tuple[str, ...]) -> str:
+    if not label_names:
+        return ""
+    pairs = ", ".join(
+        f'{n}="{v}"' for n, v in zip(label_names, label_values)
+    )
+    return "{" + pairs + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values: str) -> "_Bound":
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected {len(self.label_names)} labels, got {len(values)}"
+            )
+        return _Bound(self, tuple(str(v) for v in values))
+
+    # unlabeled shortcuts
+    def _key(self) -> Tuple[str, ...]:
+        return ()
+
+    def expose(self) -> List[str]:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for label_values, v in items:
+            out.append(
+                f"{self.name}{_fmt_labels(self.label_names, label_values)} {_num(v)}"
+            )
+        return out
+
+
+def _num(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(v)
+
+
+class _Bound:
+    __slots__ = ("metric", "values")
+
+    def __init__(self, metric: _Metric, values: Tuple[str, ...]):
+        self.metric = metric
+        self.values = values
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self.metric._lock:
+            self.metric._values[self.values] = (
+                self.metric._values.get(self.values, 0.0) + amount
+            )
+
+    def set(self, value: float) -> None:
+        with self.metric._lock:
+            self.metric._values[self.values] = float(value)
+
+    def observe(self, value: float) -> None:
+        self.metric.observe_labels(self.values, value)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0) -> None:
+        if self.label_names:
+            raise ValueError(f"{self.name} is labeled; use .labels(...).inc()")
+        _Bound(self, ()).inc(amount)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float) -> None:
+        _Bound(self, ()).set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        _Bound(self, ()).inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        _Bound(self, ()).inc(-amount)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (prometheus semantics)."""
+
+    kind = "histogram"
+    DEFAULT_BUCKETS = (
+        0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0,
+    )
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float) -> None:
+        self.observe_labels((), value)
+
+    def observe_labels(self, label_values: Tuple[str, ...], value: float) -> None:
+        with self._lock:
+            counts = self._counts.setdefault(label_values, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[label_values] = self._sums.get(label_values, 0.0) + value
+            self._totals[label_values] = self._totals.get(label_values, 0) + 1
+
+    def expose(self) -> List[str]:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            items = sorted(self._counts.items())
+            for label_values, counts in items:
+                names = self.label_names + ("le",)
+                for i, b in enumerate(self.buckets):
+                    out.append(
+                        f"{self.name}_bucket{_fmt_labels(names, label_values + (_num(b),))} {counts[i]}"
+                    )
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(names, label_values + ('+Inf',))} "
+                    f"{self._totals[label_values]}"
+                )
+                out.append(
+                    f"{self.name}_sum{_fmt_labels(self.label_names, label_values)} "
+                    f"{_num(self._sums[label_values])}"
+                )
+                out.append(
+                    f"{self.name}_count{_fmt_labels(self.label_names, label_values)} "
+                    f"{self._totals[label_values]}"
+                )
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            if any(m.name == metric.name for m in self._metrics):
+                raise ValueError(f"duplicate metric {metric.name}")
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name, help_, labels=()) -> Counter:
+        return self.register(Counter(name, help_, labels))
+
+    def gauge(self, name, help_, labels=()) -> Gauge:
+        return self.register(Gauge(name, help_, labels))
+
+    def histogram(self, name, help_, labels=(), buckets=None) -> Histogram:
+        return self.register(Histogram(name, help_, labels, buckets))
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------- per-subsystem metric sets
+
+
+class ConsensusMetrics:
+    """reference: consensus/metrics.go:28."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_consensus"
+        self.height = reg.gauge(f"{ns}_height", "Height of the chain.")
+        self.rounds = reg.gauge(f"{ns}_rounds", "Number of rounds at the latest height.")
+        self.validators = reg.gauge(f"{ns}_validators", "Number of validators.")
+        self.validators_power = reg.gauge(
+            f"{ns}_validators_power", "Total voting power of validators."
+        )
+        self.missing_validators = reg.gauge(
+            f"{ns}_missing_validators", "Validators absent from the last commit."
+        )
+        self.byzantine_validators = reg.gauge(
+            f"{ns}_byzantine_validators", "Validators with evidence this height."
+        )
+        self.num_txs = reg.gauge(f"{ns}_num_txs", "Transactions in the latest block.")
+        self.block_size_bytes = reg.gauge(
+            f"{ns}_block_size_bytes", "Size of the latest block."
+        )
+        self.total_txs = reg.counter(f"{ns}_total_txs", "Total committed transactions.")
+        self.block_interval_seconds = reg.histogram(
+            f"{ns}_block_interval_seconds", "Time between this and the last block."
+        )
+        self.commit_verify_seconds = reg.histogram(
+            f"{ns}_commit_verify_seconds",
+            "Wall time of (batched) commit signature verification.",
+        )
+
+
+class MempoolMetrics:
+    """reference: mempool/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_mempool"
+        self.size = reg.gauge(f"{ns}_size", "Transactions in the mempool.")
+        self.tx_size_bytes = reg.histogram(
+            f"{ns}_tx_size_bytes", "Transaction sizes.",
+            buckets=(32, 128, 512, 2048, 8192, 65536, 1048576),
+        )
+        self.failed_txs = reg.counter(f"{ns}_failed_txs", "CheckTx failures.")
+        self.recheck_times = reg.counter(f"{ns}_recheck_times", "Recheck runs.")
+
+
+class P2PMetrics:
+    """reference: p2p/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_p2p"
+        self.peers = reg.gauge(f"{ns}_peers", "Connected peers.")
+        self.peer_receive_bytes_total = reg.counter(
+            f"{ns}_peer_receive_bytes_total", "Bytes received per channel.", ("chID",)
+        )
+        self.peer_send_bytes_total = reg.counter(
+            f"{ns}_peer_send_bytes_total", "Bytes sent per channel.", ("chID",)
+        )
+
+
+class StateMetrics:
+    """reference: state/metrics.go."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_state"
+        self.block_processing_time = reg.histogram(
+            f"{ns}_block_processing_time", "ApplyBlock wall seconds.",
+        )
+
+
+class NodeMetrics:
+    """One registry + all subsystem metric sets
+    (reference: node/node.go:106 DefaultMetricsProvider)."""
+
+    def __init__(self):
+        self.registry = Registry()
+        self.consensus = ConsensusMetrics(self.registry)
+        self.mempool = MempoolMetrics(self.registry)
+        self.p2p = P2PMetrics(self.registry)
+        self.state = StateMetrics(self.registry)
+
+    def expose(self) -> str:
+        return self.registry.expose()
+
+

@@ -46,7 +46,9 @@ class Mempool:
         cache_size: int = 10000,
         keep_invalid_txs_in_cache: bool = False,
         recheck: bool = True,
+        metrics=None,
     ):
+        self.metrics = metrics
         self.proxy_app = proxy_app
         self.max_txs = max_txs
         self.max_txs_bytes = max_txs_bytes
@@ -139,6 +141,11 @@ class Mempool:
             else:
                 if not self.keep_invalid_txs_in_cache:
                     self._cache.pop(key, None)
+                if self.metrics is not None:
+                    self.metrics.failed_txs.inc()
+            if self.metrics is not None:
+                self.metrics.size.set(len(self._txs))
+                self.metrics.tx_size_bytes.observe(len(tx))
             return res
 
     def entries(self) -> List[tuple]:
@@ -195,7 +202,11 @@ class Mempool:
             if old is not None:
                 self._total_bytes -= len(old.tx)
         if self.recheck and self._txs:
+            if self.metrics is not None:
+                self.metrics.recheck_times.inc()
             self._recheck_txs()
+        if self.metrics is not None:
+            self.metrics.size.set(len(self._txs))
         if self._txs:
             self._notify_txs_available()
 

@@ -76,6 +76,11 @@ class Node:
             self._owns_priv_validator = True
         self.priv_validator = priv_validator
 
+        # metrics (reference: node/node.go:106 DefaultMetricsProvider)
+        from tendermint_tpu.libs.metrics import NodeMetrics
+
+        self.metrics = NodeMetrics()
+
         # databases
         self.block_db = _open_db(config, "blockstore")
         self.state_db = _open_db(config, "state")
@@ -114,6 +119,7 @@ class Node:
             cache_size=config.mempool.cache_size,
             keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
             recheck=config.mempool.recheck,
+            metrics=self.metrics.mempool,
         )
 
         # evidence pool
@@ -128,6 +134,7 @@ class Node:
             self.evidence_pool,
             event_bus=self.event_bus,
             block_store=self.block_store,
+            metrics=self.metrics.state,
         )
 
         # consensus
@@ -148,6 +155,7 @@ class Node:
             self.wal,
             event_bus=self.event_bus,
             priv_validator=priv_validator,
+            metrics=self.metrics.consensus,
         )
 
         self.rpc_server = None
@@ -191,7 +199,7 @@ class Node:
                 moniker=config.base.moniker,
             )
             transport = MultiplexTransport(self.node_key, node_info)
-            self.switch = Switch(transport)
+            self.switch = Switch(transport, metrics=self.metrics.p2p)
             # fast sync is pointless when we are the only validator
             # (reference: node/node.go onlyValidatorIsUs)
             only_us = (

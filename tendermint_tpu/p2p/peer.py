@@ -18,7 +18,9 @@ class Peer:
         outbound: bool,
         persistent: bool = False,
         socket_addr: str = "",
+        metrics=None,
     ):
+        self.metrics = metrics
         self.node_info = node_info
         self.mconn = mconn
         self.outbound = outbound
@@ -31,6 +33,8 @@ class Peer:
         return self.node_info.node_id
 
     async def send(self, chan_id: int, msg: bytes) -> bool:
+        if self.metrics is not None:
+            self.metrics.peer_send_bytes_total.labels(f"{chan_id:#x}").inc(len(msg))
         return await self.mconn.send(chan_id, msg)
 
     def try_send(self, chan_id: int, msg: bytes) -> bool:

@@ -27,7 +27,8 @@ RECONNECT_BASE_DELAY = 0.5
 
 
 class Switch:
-    def __init__(self, transport: MultiplexTransport, max_peers: int = 50):
+    def __init__(self, transport: MultiplexTransport, max_peers: int = 50, metrics=None):
+        self.metrics = metrics
         self.transport = transport
         self.peers = PeerSet()
         self.reactors: Dict[str, Reactor] = {}
@@ -134,19 +135,24 @@ class Switch:
             reactor = self._chan_to_reactor.get(chan_id)
             if reactor is None:
                 raise ValueError(f"no reactor for channel {chan_id:#x}")
+            if self.metrics is not None:
+                self.metrics.peer_receive_bytes_total.labels(f"{chan_id:#x}").inc(len(msg))
             await reactor.receive(chan_id, peer_holder[0], msg)
 
         async def on_error(e: Exception) -> None:
             await self.stop_peer_for_error(peer_holder[0], e)
 
         mconn = MConnection(conn.transport, self._channel_descs, on_receive, on_error)
-        peer = Peer(ni, mconn, conn.outbound, persistent, conn.socket_addr)
+        peer = Peer(ni, mconn, conn.outbound, persistent, conn.socket_addr,
+                    metrics=self.metrics)
         peer_holder.append(peer)
         self.peers.add(peer)
         mconn.start()
         for reactor in self.reactors.values():
             await reactor.add_peer(peer)
         logger.info("added peer %s (%s)", ni.node_id[:10], ni.moniker)
+        if self.metrics is not None:
+            self.metrics.peers.set(self.peers.size())
         return peer
 
     async def stop_peer_for_error(self, peer: Peer, reason) -> None:
@@ -166,6 +172,8 @@ class Switch:
 
     async def _stop_and_remove_peer(self, peer: Peer, reason) -> None:
         self.peers.remove(peer.id)
+        if self.metrics is not None:
+            self.metrics.peers.set(self.peers.size())
         await peer.stop()
         for reactor in self.reactors.values():
             try:

@@ -49,6 +49,7 @@ class RPCServer:
         self.port = int(port) if port else 0  # 0: handler-only (LocalClient)
         self.app = web.Application(client_max_size=node.config.rpc.max_body_bytes)
         self.app.router.add_post("/", self._handle_jsonrpc)
+        self.app.router.add_get("/metrics", self._handle_metrics)
         self.app.router.add_get("/websocket", self._handle_websocket)
         self.app.router.add_get("/{method}", self._handle_uri)
         self.runner: Optional[web.AppRunner] = None
@@ -110,6 +111,17 @@ class RPCServer:
         except Exception as e:
             logger.exception("rpc error in %s", method)
             return web.json_response(_error(id_, -32603, "internal error", str(e)))
+
+    async def _handle_metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition (reference: the :26660 /metrics
+        endpoint, node/node.go:861; served on the RPC listener here)."""
+        if not self.node.config.instrumentation.prometheus:
+            return web.Response(status=404, text="instrumentation disabled")
+        return web.Response(
+            text=self.node.metrics.expose(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
 
     async def _handle_uri(self, request: web.Request) -> web.Response:
         method = request.match_info["method"]

@@ -52,7 +52,9 @@ class BlockExecutor:
         evidence_pool,
         event_bus=None,
         block_store=None,
+        metrics=None,
     ):
+        self.metrics = metrics
         self.state_store = state_store
         self.proxy_app = proxy_app
         self.mempool = mempool
@@ -134,6 +136,18 @@ class BlockExecutor:
         self, state: State, block_id: BlockID, block: Block, trust_last_commit: bool = False
     ) -> State:
         """(reference: state/execution.go:126 ApplyBlock)"""
+        import time as _time
+
+        _t0 = _time.perf_counter()
+        try:
+            return self._apply_block(state, block_id, block, trust_last_commit)
+        finally:
+            if self.metrics is not None:
+                self.metrics.block_processing_time.observe(_time.perf_counter() - _t0)
+
+    def _apply_block(
+        self, state: State, block_id: BlockID, block: Block, trust_last_commit: bool = False
+    ) -> State:
         self.validate_block(state, block, trust_last_commit=trust_last_commit)
 
         abci_responses = self._exec_block_on_proxy_app(state, block)

@@ -1,0 +1,128 @@
+"""Metrics registry + node instrumentation
+(reference model: the per-service metrics.go files + prometheus endpoint)."""
+
+import asyncio
+import os
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.libs.metrics import Counter, Gauge, Histogram, NodeMetrics, Registry
+
+
+def test_registry_exposition_format():
+    reg = Registry()
+    c = reg.counter("tm_test_total", "Things.", ("kind",))
+    g = reg.gauge("tm_height", "Height.")
+    h = reg.histogram("tm_lat", "Latency.", buckets=(0.1, 1.0))
+
+    c.labels("a").inc()
+    c.labels("a").inc(2)
+    c.labels("b").inc()
+    g.set(42)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    text = reg.expose()
+    assert 'tm_test_total{kind="a"} 3' in text
+    assert 'tm_test_total{kind="b"} 1' in text
+    assert "tm_height 42" in text
+    assert 'tm_lat_bucket{le="0.1"} 1' in text
+    assert 'tm_lat_bucket{le="1"} 2' in text
+    assert 'tm_lat_bucket{le="+Inf"} 3' in text
+    assert "tm_lat_count 3" in text
+    assert "# TYPE tm_test_total counter" in text
+    assert "# TYPE tm_height gauge" in text
+    assert "# TYPE tm_lat histogram" in text
+
+
+def test_node_metrics_populated_and_served(tmp_path):
+    """A running node populates consensus/mempool metrics and serves
+    /metrics over HTTP when instrumentation is on."""
+    import aiohttp
+
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import gen_ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    async def run():
+        import socket as s
+
+        sock = s.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+
+        cfg = test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.root_dir = ""
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{port}"
+        cfg.consensus.wal_path = str(tmp_path / "wal")
+        cfg.instrumentation.prometheus = True
+        priv = FilePV(gen_ed25519(b"\x51" * 32))
+        gen = GenesisDoc(chain_id="metrics-chain",
+                         validators=[GenesisValidator(priv.get_pub_key(), 10)])
+        node = Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
+        await node.start()
+        try:
+            node.mempool.check_tx(b"m=1")
+            await node.wait_for_height(3, timeout=60)
+
+            # gauges track the chain
+            text = node.metrics.expose()
+            assert "tendermint_consensus_height" in text
+            h = [l for l in text.splitlines() if l.startswith("tendermint_consensus_height ")]
+            assert int(float(h[0].split()[-1])) >= 3
+            assert "tendermint_consensus_validators 1" in text
+            assert "tendermint_state_block_processing_time_count" in text
+
+            # HTTP exposition
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(f"http://127.0.0.1:{port}/metrics") as resp:
+                    assert resp.status == 200
+                    body = await resp.text()
+                    assert "tendermint_consensus_height" in body
+                    assert "tendermint_mempool_size" in body
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+
+def test_metrics_endpoint_404_when_disabled(tmp_path):
+    import aiohttp
+
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import gen_ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    async def run():
+        import socket as s
+
+        sock = s.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        cfg = test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.root_dir = ""
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{port}"
+        cfg.consensus.wal_path = str(tmp_path / "wal")
+        priv = FilePV(gen_ed25519(b"\x52" * 32))
+        gen = GenesisDoc(chain_id="m2", validators=[GenesisValidator(priv.get_pub_key(), 10)])
+        node = Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
+        await node.start()
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(f"http://127.0.0.1:{port}/metrics") as resp:
+                    assert resp.status == 404
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
