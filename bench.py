@@ -3,15 +3,18 @@
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
-The headline metric is the north star (BASELINE.md): wall latency to verify a
-10k-validator commit on TPU, with vs_baseline = serial-CPU-time / TPU-time
-(the reference's serial loop semantics, types/validator_set.go:680-702).
+The headline metric is the LARGEST config that completed within the time
+budget (TMTPU_BENCH_BUDGET_S, default 1500s) — ideally the north star
+(BASELINE.md): wall latency to verify a 10k-validator commit on TPU, with
+vs_baseline = serial-CPU-time / TPU-time (the reference's serial loop
+semantics, types/validator_set.go:680-702). The metric name carries the
+config, e.g. "verify_commit_10k_latency".
 
-Sub-benchmarks (in "extra"):
+Sub-benchmarks (in "extra", budget permitting):
   batch128            — 128-sig batch verify (BASELINE config 1)
   verify_commit_1k    — VerifyCommit, 1k validators (config 2)
   light_trusting_4k   — VerifyCommitLightTrusting, 4k validators (config 3)
-  streaming_10k       — sustained sigs/s over repeated 10k batches (config 5)
+  streaming_{n}_sigs_per_sec — sustained sigs/s over repeated headline batches
 
 Run WITHOUT the test conftest (needs the real TPU): `python bench.py`.
 """
@@ -102,39 +105,71 @@ def bench_config(name: str, n: int, serial_n: int | None = None):
 
 
 def main():
+    """Time-budgeted: each config runs only if enough budget remains (first
+    compiles are minutes); the final JSON ALWAYS prints, with the largest
+    completed config as the headline. Budget via TMTPU_BENCH_BUDGET_S."""
+    import os
+
     import jax
 
     log("devices:", jax.devices())
+    budget = float(os.environ.get("TMTPU_BENCH_BUDGET_S", "1500"))
+    t_start = time.perf_counter()
+
+    def remaining():
+        return budget - (time.perf_counter() - t_start)
 
     extra = {}
-    extra["batch128"] = bench_config("batch128", 128)
-    extra["verify_commit_1k"] = bench_config("verify_commit_1k", 1000)
-    extra["light_trusting_4k"] = bench_config("light_trusting_4k", 4096, serial_n=1024)
-    head = bench_config("verify_commit_10k", 10000, serial_n=1024)
-    extra["verify_commit_10k"] = head
+    head = None
+    plan = [
+        ("batch128", 128, None),
+        ("verify_commit_1k", 1000, None),
+        ("light_trusting_4k", 4096, 1024),
+        ("verify_commit_10k", 10000, 1024),
+    ]
+    # rough per-config cost: compile (~2-5 min for a fresh bucket) + run
+    for i, (name, n, serial_n) in enumerate(plan):
+        need = 420.0
+        if i > 0 and remaining() < need:
+            log(f"[{name}] skipped: {remaining():.0f}s left < {need:.0f}s budget")
+            break
+        try:
+            res = bench_config(name, n, serial_n=serial_n)
+        except Exception as e:  # a failed config must not lose the others
+            log(f"[{name}] FAILED: {e}")
+            break
+        extra[name] = res
+        head = (name, res)
 
-    # streaming: sustained throughput over 5 consecutive 10k batches (compile warm)
-    from tendermint_tpu.crypto.batch import prepare_batch
-    from tendermint_tpu.ops.ed25519_jax import verify_prepared
+    # streaming: sustained throughput over consecutive batches (compile warm)
+    if head is not None and remaining() > 60:
+        from tendermint_tpu.crypto.batch import prepare_batch
+        from tendermint_tpu.ops.ed25519_jax import verify_prepared
 
-    pubkeys, msgs, sigs = make_batch(10000)
-    t0 = time.perf_counter()
-    reps = 5
-    for _ in range(reps):
-        a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
-        mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
-        assert (mask & precheck).all()
-    stream = reps * 10000 / (time.perf_counter() - t0)
-    extra["streaming_10k_sigs_per_sec"] = round(stream)
-    log(f"[streaming] {stream:,.0f} sigs/s sustained")
+        sn = head[1]["n"]
+        pubkeys, msgs, sigs = make_batch(sn)
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
+            mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
+            assert (mask & precheck).all()
+        stream = reps * sn / (time.perf_counter() - t0)
+        extra[f"streaming_{sn}_sigs_per_sec"] = round(stream)
+        log(f"[streaming] {stream:,.0f} sigs/s sustained")
 
+    if head is None:
+        print(json.dumps({"metric": "verify_commit_latency", "value": -1,
+                          "unit": "ms", "vs_baseline": 0, "extra": {"error": "no config completed"}}))
+        return
+    name, res = head
     print(
         json.dumps(
             {
-                "metric": "verify_commit_10k_latency",
-                "value": head["tpu_e2e_ms"],
+                "metric": f"{name}_latency",
+                "value": res["tpu_e2e_ms"],
                 "unit": "ms",
-                "vs_baseline": head["speedup_e2e"],
+                "vs_baseline": res["speedup_e2e"],
                 "extra": extra,
             }
         )

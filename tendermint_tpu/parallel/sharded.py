@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tendermint_tpu.ops.ed25519_jax import verify_prepared
+from tendermint_tpu.ops.ed25519_jax import _verify_core, make_ctx, verify_prepared
 
 
 def make_mesh(devices=None, shape=None, axis_names=("vals",)) -> Mesh:
@@ -45,22 +45,31 @@ def sharded_verify(mesh: Mesh):
     mesh axes right-aligned: the last input axis onto the last mesh axis, etc.
     Returns the bool mask with the same sharded layout.
     """
-    n_batch_axes = len(mesh.axis_names)
     spec_in = P(None, *mesh.axis_names)
     spec_out = P(*mesh.axis_names)
+    # ctx is replicated: every chip gets the same materialized constants
+    # sized for ITS shard, so the fast (real-buffer) path runs per shard.
+    spec_ctx = jax.tree.map(lambda _: P(), make_ctx(()))
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(spec_in, spec_in, spec_in, spec_in),
+        in_specs=(spec_in, spec_in, spec_in, spec_in, spec_ctx),
         out_specs=spec_out,
         check_vma=False,
     )
-    def _verify(a, r, s_bits, h_bits):
-        return verify_prepared(a, r, s_bits, h_bits)
+    def _verify(a, r, s_bits, h_bits, ctx):
+        return _verify_core(a, r, s_bits, h_bits, ctx)
 
-    del n_batch_axes
-    return jax.jit(_verify)
+    jitted = jax.jit(_verify)
+
+    def run(a, r, s_bits, h_bits):
+        shard_batch = tuple(
+            d // m for d, m in zip(a.shape[1:], mesh.devices.shape)
+        )
+        return jitted(a, r, s_bits, h_bits, make_ctx(shard_batch))
+
+    return run
 
 
 def sharded_commit_step(mesh: Mesh):
@@ -77,12 +86,13 @@ def sharded_commit_step(mesh: Mesh):
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(spec_in, spec_in, spec_in, spec_in, spec_in),
+        in_specs=(spec_in, spec_in, spec_in, spec_in, spec_in,
+                  jax.tree.map(lambda _: P(), make_ctx(()))),
         out_specs=(spec_p, P(), P()),
         check_vma=False,
     )
-    def _step(a, r, s_bits, h_bits, power_planes):
-        mask = verify_prepared(a, r, s_bits, h_bits)
+    def _step(a, r, s_bits, h_bits, power_planes, ctx):
+        mask = _verify_core(a, r, s_bits, h_bits, ctx)
         # Exact int64 tallies without x64: powers arrive as four uint32 planes
         # of 16 bits each (see split_powers). Each plane sum is bounded by
         # N*2^16, safe in uint32 for N up to 2^15 validators per shard; psum
@@ -101,7 +111,12 @@ def sharded_commit_step(mesh: Mesh):
     def step(a, r, s_bits, h_bits, power_planes):
         import numpy as np
 
-        mask, talled, total = stepped(a, r, s_bits, h_bits, power_planes)
+        shard_batch = tuple(
+            d // m for d, m in zip(a.shape[1:], mesh.devices.shape)
+        )
+        mask, talled, total = stepped(
+            a, r, s_bits, h_bits, power_planes, make_ctx(shard_batch)
+        )
 
         def _join(planes) -> int:
             return sum(int(v) << (16 * k) for k, v in enumerate(np.asarray(planes)))

@@ -14,6 +14,10 @@ def fe_batch(ints):
     return np.stack([fe.from_int(x) for x in ints], axis=-1)
 
 
+def ctx_for(n):
+    return ed.make_ctx((n,))
+
+
 def point_batch(points):
     """List of reference extended points -> JAX Point batch."""
     return ed.Point(
@@ -50,7 +54,7 @@ def assert_points_equal(jp, ref_points):
 def test_point_add_matches_reference():
     n = 8
     ps, qs = rand_points(n), rand_points(n)
-    out = ed.point_add(point_batch(ps), point_batch(qs))
+    out = ed.point_add(ctx_for(n), point_batch(ps), point_batch(qs))
     assert_points_equal(out, [ref.point_add(p, q) for p, q in zip(ps, qs)])
 
 
@@ -58,9 +62,9 @@ def test_point_double_matches_reference_and_unified_add():
     n = 8
     ps = rand_points(n)
     jp = point_batch(ps)
-    doubled = ed.point_double(jp)
+    doubled = ed.point_double(ctx_for(n), jp)
     assert_points_equal(doubled, [ref.point_double(p) for p in ps])
-    via_add = ed.point_add(jp, jp)
+    via_add = ed.point_add(ctx_for(n), jp, jp)
     for i in range(n):
         assert ref.point_equal(point_to_ints(doubled, i), point_to_ints(via_add, i))
 
@@ -68,10 +72,10 @@ def test_point_double_matches_reference_and_unified_add():
 def test_add_identity_and_double_identity():
     n = 4
     ps = rand_points(n)
-    ident = ed.identity((n,))
-    out = ed.point_add(point_batch(ps), ident)
+    ident = ed.identity(ctx_for(n))
+    out = ed.point_add(ctx_for(n), point_batch(ps), ident)
     assert_points_equal(out, ps)
-    out2 = ed.point_double(ident)
+    out2 = ed.point_double(ctx_for(n), ident)
     assert_points_equal(out2, [ref.IDENTITY] * n)
 
 
@@ -82,7 +86,7 @@ def test_compress_decompress_roundtrip():
     enc = np.asarray(ed.compress(point_batch(ps)))
     for i in range(n):
         assert enc[:, i].tobytes() == enc_ref[i]
-    dec, ok = ed.decompress(np.stack([np.frombuffer(e, dtype=np.uint8) for e in enc_ref], axis=-1))
+    dec, ok = ed.decompress(ctx_for(len(enc_ref)), np.stack([np.frombuffer(e, dtype=np.uint8) for e in enc_ref], axis=-1))
     assert np.asarray(ok).all()
     assert_points_equal(dec, ps)
 
@@ -101,7 +105,7 @@ def test_decompress_rejects_invalid():
         [np.frombuffer(x, dtype=np.uint8) for x in (good, bad_not_on_curve, noncanonical)],
         axis=-1,
     )
-    _, ok = ed.decompress(arr)
+    _, ok = ed.decompress(ctx_for(3), arr)
     assert list(np.asarray(ok)) == [True, False, False]
 
 

@@ -37,11 +37,11 @@ def test_add_sub_mul_random():
 
 
 def test_mul_worst_case_limbs():
-    # All limbs at their loose maximum: 2^{w_i}-1 (+38 on limb 0) — the bound
-    # the uint32 accumulation analysis relies on.
-    big = np.array([(1 << w) - 1 for w in fe.W[: fe.NLIMBS]], dtype=np.uint32)
+    # All limbs at the carried maximum: 2^13 (i >= 1), 2^13 + 607 on limb 0 —
+    # the bound the int32 accumulation analysis relies on (see fe25519.carry).
+    big = np.array([1 << fe.RADIX] * fe.NLIMBS, dtype=np.int32)
     big0 = big.copy()
-    big0[0] += 38
+    big0[0] += 607
     A = np.stack([big0, big], axis=-1)
     va = [fe.to_int(A[:, i]) for i in range(2)]
     got = batch_to_ints(fe.mul(A, A))
