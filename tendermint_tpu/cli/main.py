@@ -98,10 +98,9 @@ def run_node(home: str) -> None:
     from tendermint_tpu.types.genesis import GenesisDoc
 
     cfg = load_home(home)
-    logging.basicConfig(
-        level=getattr(logging, cfg.base.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    from tendermint_tpu.libs import log as tmlog
+
+    tmlog.setup(cfg.base.log_level)
     with open(cfg.genesis_path()) as f:
         gen = GenesisDoc.from_json(f.read())
     pv = None
@@ -185,13 +184,54 @@ def make_testnet(output_dir: str, n_validators: int, chain_id: str = "",
     return out
 
 
+# ------------------------------------------------------------------ debug
+
+
+def debug_dump(home: str, rpc_url: str, output: str) -> None:
+    """Capture node state + config + WAL into a zip
+    (reference: cmd/tendermint/commands/debug/dump.go:117-125)."""
+    import zipfile
+
+    cfg = load_home(home)
+    with zipfile.ZipFile(output, "w", zipfile.ZIP_DEFLATED) as z:
+        if rpc_url:
+            from tendermint_tpu.rpc.client import HTTPClient
+
+            async def fetch():
+                client = HTTPClient(rpc_url)
+                try:
+                    for method in ("status", "net_info", "dump_consensus_state"):
+                        try:
+                            res = await client.call(method)
+                            z.writestr(f"{method}.json", json.dumps(res, indent=2))
+                        except Exception as e:
+                            z.writestr(f"{method}.error.txt", str(e))
+                finally:
+                    await client.close()
+
+            asyncio.run(fetch())
+        for rel in ("config/config.toml", "config/genesis.json"):
+            path = cfg.path(rel)
+            if os.path.exists(path):
+                z.write(path, rel)
+        wal_dir = cfg.path(cfg.consensus.wal_path)
+        if os.path.isdir(wal_dir):
+            for fn in sorted(os.listdir(wal_dir)):
+                z.write(os.path.join(wal_dir, fn), f"wal/{fn}")
+        elif os.path.isfile(wal_dir):
+            z.write(wal_dir, "wal/" + os.path.basename(wal_dir))
+
+
 # ------------------------------------------------------------------ light
 
 
 def run_light(chain_id: str, primary: str, witnesses: list, trust_height: int,
-              trust_hash: str, home: str, height: int | None) -> None:
-    """Verify a header via the light client against live RPC endpoints
-    (reference: cmd/tendermint/commands/lite.go `tendermint light`)."""
+              trust_hash: str, home: str, height: int | None,
+              laddr: str = "") -> None:
+    """Verify a header via the light client against live RPC endpoints; with
+    --laddr, keep running as a verifying RPC proxy
+    (reference: cmd/tendermint/commands/lite.go `tendermint light` +
+    light/proxy/proxy.go)."""
     from tendermint_tpu.libs.kvdb import SQLiteDB
     from tendermint_tpu.light import Client, HTTPProvider, LightStore, TrustOptions
     from tendermint_tpu.rpc.client import HTTPClient
@@ -210,6 +250,27 @@ def run_light(chain_id: str, primary: str, witnesses: list, trust_height: int,
             store,
         )
         try:
+            if laddr:
+                from tendermint_tpu.light.proxy import LightProxy
+
+                addr = laddr.replace("tcp://", "")
+                if ":" in addr:
+                    host, _, port_s = addr.rpartition(":")
+                else:
+                    host, port_s = addr, "0"
+                proxy = LightProxy(lc, clients[0], host or "127.0.0.1", int(port_s or 0))
+                await proxy.start()
+                print(json.dumps({"proxy": proxy.addr}), flush=True)
+                stop = asyncio.Event()
+                loop = asyncio.get_event_loop()
+                for sig in (signal.SIGINT, signal.SIGTERM):
+                    try:
+                        loop.add_signal_handler(sig, stop.set)
+                    except NotImplementedError:
+                        pass
+                await stop.wait()
+                await proxy.stop()
+                return
             await lc.initialize()
             lb = (
                 await lc.verify_light_block_at_height(height)
@@ -257,6 +318,12 @@ def main(argv=None) -> int:
     sub.add_parser("unsafe-reset-all", help="wipe data dir, keep config + keys")
     sub.add_parser("version", help="print version")
 
+    sp = sub.add_parser(
+        "debug", help="capture a debug dump (node state over RPC + config + WAL) into a zip"
+    )
+    sp.add_argument("--rpc", default="", help="RPC URL of the running node (optional)")
+    sp.add_argument("--output", default="debug_dump.zip")
+
     sp = sub.add_parser("light", help="light client: verify headers over RPC")
     sp.add_argument("chain_id")
     sp.add_argument("--primary", required=True, help="primary RPC URL")
@@ -264,6 +331,7 @@ def main(argv=None) -> int:
     sp.add_argument("--trust-height", type=int, required=True)
     sp.add_argument("--trust-hash", required=True)
     sp.add_argument("--height", type=int, default=None)
+    sp.add_argument("--laddr", default="", help="run a verifying RPC proxy on this address")
 
     args = p.parse_args(argv)
 
@@ -288,8 +356,9 @@ def main(argv=None) -> int:
             cfg.path(cfg.base.priv_validator_key_file),
             cfg.path(cfg.base.priv_validator_state_file),
         )
-        pub = pv.get_pub_key()
-        print(json.dumps({"type": pub.type_name(), "value": pub.bytes().hex()}))
+        from tendermint_tpu.libs import amino_json
+
+        print(amino_json.marshal(pv.get_pub_key()))
     elif args.cmd == "gen-validator":
         from tendermint_tpu.crypto.keys import gen_ed25519
 
@@ -311,12 +380,16 @@ def main(argv=None) -> int:
         if os.path.exists(state_file):
             os.unlink(state_file)
         print(json.dumps({"reset": args.home}))
+    elif args.cmd == "debug":
+        debug_dump(args.home, args.rpc, args.output)
+        print(json.dumps({"dump": args.output}))
     elif args.cmd == "version":
         print(VERSION)
     elif args.cmd == "light":
         run_light(
             args.chain_id, args.primary, args.witness,
             args.trust_height, args.trust_hash, args.home, args.height,
+            laddr=args.laddr,
         )
     return 0
 

@@ -59,6 +59,8 @@ class P2PConfig:
     allow_duplicate_ip: bool = False
     handshake_timeout: float = 20.0
     dial_timeout: float = 3.0
+    # test-only adversarial I/O (reference: config/config.go TestFuzz)
+    test_fuzz: bool = False
 
 
 @dataclass

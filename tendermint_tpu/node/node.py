@@ -198,7 +198,12 @@ class Node:
                 network=genesis.chain_id,
                 moniker=config.base.moniker,
             )
-            transport = MultiplexTransport(self.node_key, node_info)
+            fuzz_cfg = None
+            if config.p2p.test_fuzz:
+                from tendermint_tpu.p2p.fuzz import FuzzConfig
+
+                fuzz_cfg = FuzzConfig()
+            transport = MultiplexTransport(self.node_key, node_info, fuzz_config=fuzz_cfg)
             self.switch = Switch(transport, metrics=self.metrics.p2p)
             # fast sync is pointless when we are the only validator
             # (reference: node/node.go onlyValidatorIsUs)

@@ -38,10 +38,14 @@ class Connection:
 
 
 class MultiplexTransport:
-    def __init__(self, node_key: NodeKey, node_info: NodeInfo, use_secret_conn: bool = True):
+    def __init__(self, node_key: NodeKey, node_info: NodeInfo, use_secret_conn: bool = True,
+                 fuzz_config=None):
         self.node_key = node_key
         self.node_info = node_info
         self.use_secret_conn = use_secret_conn
+        # adversarial I/O injection for tests (reference: p2p/fuzz.go wired
+        # via config TestFuzz); wraps every upgraded stream when set
+        self.fuzz_config = fuzz_config
         self._server: Optional[asyncio.base_events.Server] = None
         self._accept_queue: asyncio.Queue = asyncio.Queue(maxsize=64)
         self.listen_addr = ""
@@ -124,6 +128,10 @@ class MultiplexTransport:
         if peer_ni.node_id == self.node_info.node_id:
             raise TransportError("connected to self")
         self.node_info.compatible_with(peer_ni)
+        if self.fuzz_config is not None:
+            from tendermint_tpu.p2p.fuzz import FuzzedConnection
+
+            transport = FuzzedConnection(transport, self.fuzz_config)
         return Connection(transport, peer_ni, outbound, "")
 
 

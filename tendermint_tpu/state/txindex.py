@@ -12,6 +12,7 @@ from typing import List, Optional
 
 from tendermint_tpu.crypto import tmhash
 from tendermint_tpu.libs.kvdb import KVDB
+from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.libs.pubsub import Query
 from tendermint_tpu.types.event_bus import EVENT_TX, EventBus, query_for_event
 
@@ -82,16 +83,18 @@ class KVTxIndexer:
         return out
 
 
-class IndexerService:
-    """(reference: state/txindex/indexer_service.go)"""
+class IndexerService(BaseService):
+    """(reference: state/txindex/indexer_service.go; lifecycle via
+    libs/service.BaseService like the reference's cmn.BaseService)"""
 
     def __init__(self, indexer: KVTxIndexer, event_bus: EventBus):
+        super().__init__("IndexerService")
         self.indexer = indexer
         self.event_bus = event_bus
         self._task: Optional[asyncio.Task] = None
         self._sub = None
 
-    async def start(self) -> None:
+    async def on_start(self) -> None:
         self._sub = self.event_bus.subscribe("tx_index", query_for_event(EVENT_TX), out_capacity=1000)
         self._task = asyncio.create_task(self._run(), name="tx-indexer")
 
@@ -117,7 +120,7 @@ class IndexerService:
         except (asyncio.CancelledError, RuntimeError):
             pass
 
-    async def stop(self) -> None:
+    async def on_stop(self) -> None:
         if self._task:
             self._task.cancel()
             try:

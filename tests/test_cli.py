@@ -99,7 +99,7 @@ def test_cli_entrypoints_run(tmp_path, capsys):
 
     assert main(["--home", home, "show-validator"]) == 0
     v = json.loads(capsys.readouterr().out)
-    assert v["type"] == "ed25519"
+    assert v["type"] == "tendermint/PubKeyEd25519"  # amino-style type tag
 
     assert main(["--home", home, "gen-validator"]) == 0
     g = json.loads(capsys.readouterr().out)

@@ -1,0 +1,149 @@
+"""Utility libs: service lifecycle, log filtering, amino JSON, fuzz conn
+(reference models: libs/service/service_test.go, libs/log/filter_test.go,
+libs/json tests, p2p/fuzz.go)."""
+
+import asyncio
+import logging
+import os
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.libs import amino_json
+from tendermint_tpu.libs import log as tmlog
+from tendermint_tpu.libs.service import (
+    AlreadyStartedError,
+    BaseService,
+    ServiceError,
+)
+
+
+class Counting(BaseService):
+    def __init__(self):
+        super().__init__("counting")
+        self.starts = 0
+        self.stops = 0
+
+    async def on_start(self):
+        self.starts += 1
+
+    async def on_stop(self):
+        self.stops += 1
+
+
+def test_service_lifecycle():
+    async def go():
+        s = Counting()
+        assert not s.is_running()
+        await s.start()
+        assert s.is_running()
+        with pytest.raises(AlreadyStartedError):
+            await s.start()
+
+        waiter = asyncio.create_task(s.wait_stopped())
+        await asyncio.sleep(0)
+        assert not waiter.done()
+        await s.stop()
+        await asyncio.wait_for(waiter, 1)
+        assert not s.is_running()
+        await s.stop()  # idempotent
+        assert s.stops == 1
+
+        # restart only after reset
+        await s.reset()
+        await s.start()
+        assert s.starts == 2
+        # reset while running is illegal
+        with pytest.raises(ServiceError):
+            await s.reset()
+        await s.stop()
+
+    asyncio.run(go())
+
+
+def test_log_level_spec_parsing_and_setup():
+    levels = tmlog.parse_level_spec("consensus:debug,p2p:none,*:error")
+    assert levels["consensus"] == logging.DEBUG
+    assert levels["p2p"] > logging.CRITICAL
+    assert levels["*"] == logging.ERROR
+
+    assert tmlog.parse_level_spec("info")["*"] == logging.INFO
+    with pytest.raises(ValueError):
+        tmlog.parse_level_spec("bogus")
+
+    tmlog.setup("consensus:debug,*:error")
+    assert logging.getLogger("tendermint_tpu.consensus").isEnabledFor(logging.DEBUG)
+    assert not logging.getLogger("tendermint_tpu").isEnabledFor(logging.INFO)
+    tmlog.setup("info")  # restore
+
+
+def test_amino_json_roundtrip_and_errors():
+    from tendermint_tpu.crypto.keys import Ed25519PubKey, gen_ed25519
+
+    priv = gen_ed25519(b"\x21" * 32)
+    pub = priv.pub_key()
+    s = amino_json.marshal(pub)
+    assert '"tendermint/PubKeyEd25519"' in s
+    back = amino_json.unmarshal(s)
+    assert isinstance(back, Ed25519PubKey)
+    assert back.bytes() == pub.bytes()
+
+    with pytest.raises(amino_json.UnregisteredTypeError):
+        amino_json.marshal(object())
+    with pytest.raises(amino_json.UnregisteredTypeError):
+        amino_json.unmarshal('{"type": "nope", "value": 1}')
+    with pytest.raises(ValueError):
+        amino_json.unmarshal('[1, 2]')
+
+
+def test_fuzzed_connection_drops_writes():
+    import random
+
+    from tendermint_tpu.p2p.fuzz import FuzzConfig, FuzzedConnection
+
+    class Sink:
+        def __init__(self):
+            self.writes = []
+            self.closed = False
+
+        async def write(self, data):
+            self.writes.append(data)
+
+        async def read(self, n):
+            return b"\x00" * n
+
+        def close(self):
+            self.closed = True
+
+    async def go():
+        sink = Sink()
+        fz = FuzzedConnection(
+            sink,
+            FuzzConfig(mode="drop", prob_drop_rw=0.5, start_after=0.0),
+            rng=random.Random(7),
+        )
+        for i in range(100):
+            await fz.write(b"%d" % i)
+        assert 10 < len(sink.writes) < 90  # some dropped, some through
+        fz.close()
+        assert sink.closed
+
+    asyncio.run(go())
+
+
+def test_debug_dump_cli(tmp_path, capsys):
+    from tendermint_tpu.cli.main import init_files, main
+
+    home = str(tmp_path / "h")
+    init_files(home, chain_id="dbg")
+    capsys.readouterr()
+    out_zip = str(tmp_path / "dump.zip")
+    assert main(["--home", home, "debug", "--output", out_zip]) == 0
+    capsys.readouterr()
+    import zipfile
+
+    with zipfile.ZipFile(out_zip) as z:
+        names = z.namelist()
+    assert "config/config.toml" in names
+    assert "config/genesis.json" in names

@@ -298,3 +298,79 @@ def test_light_client_tracks_live_node(tmp_path):
             await node.stop()
 
     run(go())
+
+
+def test_light_proxy_serves_verified_routes(tmp_path):
+    """The light proxy answers commit/validators/block with light-client
+    verification and forwards other routes
+    (reference model: light/proxy + light/rpc/client.go)."""
+    import socket as sk
+
+    import aiohttp
+
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.light.proxy import LightProxy
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.rpc.client import HTTPClient
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    async def go():
+        s = sk.socket(); s.bind(("127.0.0.1", 0)); port = s.getsockname()[1]; s.close()
+        cfg = test_config()
+        cfg.base.db_backend = "memdb"; cfg.root_dir = ""
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{port}"
+        cfg.consensus.wal_path = str(tmp_path / "wal")
+        priv = FilePV(gen_ed25519(b"\x93" * 32))
+        gen = GenesisDoc(chain_id="lp-chain",
+                         validators=[GenesisValidator(priv.get_pub_key(), 10)])
+        node = Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
+        await node.start()
+        backend = HTTPClient(f"http://127.0.0.1:{port}")
+        proxy = None
+        try:
+            await node.wait_for_height(5, timeout=60)
+            from tendermint_tpu.light import Client as LClient, HTTPProvider, LightStore, TrustOptions
+
+            provider = HTTPProvider("lp-chain", backend)
+            root = await provider.light_block(2)
+            lc = LClient("lp-chain", TrustOptions(PERIOD, 2, root.hash()),
+                         provider, [], LightStore(MemDB()))
+            proxy = LightProxy(lc, backend)
+            await proxy.start()
+
+            async with aiohttp.ClientSession() as sess:
+                async def call(method, **params):
+                    async with sess.post(f"http://{proxy.addr}/", json={
+                        "jsonrpc": "2.0", "id": 1, "method": method, "params": params,
+                    }) as resp:
+                        body = await resp.json()
+                        assert "error" not in body, body
+                        return body["result"]
+
+                com = await call("commit", height=4)
+                assert com["light_client_verified"] is True
+                assert com["signed_header"]["header"]["height"] == "4"
+
+                vals = await call("validators", height=4)
+                assert vals["light_client_verified"] is True
+                assert len(vals["validators"]) == 1
+
+                blk = await call("block", height=3)
+                assert blk["light_client_verified"] is True
+                assert blk["block"]["header"]["height"] == "3"
+
+                st = await call("status")
+                assert st["light_client"]["trusted_height"] >= 4
+
+                # unverified forwarding is marked
+                ab = await call("abci_info")
+                assert ab["light_client_verified"] is False
+        finally:
+            if proxy is not None:
+                await proxy.stop()
+            await backend.close()
+            await node.stop()
+
+    run(go())
