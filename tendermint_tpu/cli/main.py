@@ -35,6 +35,15 @@ def _config_path(home: str) -> str:
     return os.path.join(home, "config", "config.toml")
 
 
+def parse_hostport(addr: str, what: str = "address") -> tuple:
+    """'tcp://host:port' / 'host:port' -> (host, port) with a usage-grade error."""
+    bare = addr.replace("tcp://", "")
+    host, _, port_s = bare.rpartition(":")
+    if not host or not port_s.isdigit():
+        raise SystemExit(f"{what} must look like tcp://host:port, got {addr!r}")
+    return host, int(port_s)
+
+
 def load_home(home: str) -> Config:
     path = _config_path(home)
     cfg = load_config(path) if os.path.exists(path) else Config()
@@ -184,6 +193,147 @@ def make_testnet(output_dir: str, n_validators: int, chain_id: str = "",
     return out
 
 
+# --------------------------------------------------------------- localnet
+
+
+def run_localnet(output_dir: str, n_validators: int, chain_id: str,
+                 starting_port: int, blocks: int) -> None:
+    """Generate a testnet and run every node as a subprocess until all reach
+    `blocks` (the reference's networks/local docker-compose story, as plain
+    processes)."""
+    import subprocess
+    import urllib.request
+
+    if os.path.isdir(output_dir) and os.listdir(output_dir):
+        raise SystemExit(
+            f"output dir {output_dir!r} is not empty — localnet always starts "
+            "from a fresh testnet (delete it or pick another --output-dir)"
+        )
+    make_testnet(output_dir, n_validators, chain_id, starting_port)
+    homes = sorted(
+        os.path.join(output_dir, d)
+        for d in os.listdir(output_dir)
+        if d.startswith("node")
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cli", "--home", h, "start"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        for h in homes
+    ]
+
+    def height(rpc_laddr: str) -> int:
+        url = "http://" + rpc_laddr.replace("tcp://", "")
+        req = urllib.request.Request(
+            url,
+            json.dumps({"jsonrpc": "2.0", "id": 1, "method": "status", "params": {}}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            st = json.load(resp)
+        return int(st["result"]["sync_info"]["latest_block_height"])
+
+    try:
+        rpcs = [load_home(h).rpc.laddr for h in homes]
+        deadline = time.time() + 60 + 10 * blocks
+        heights = [0] * len(homes)
+        while time.time() < deadline:
+            for i, r in enumerate(rpcs):
+                try:
+                    heights[i] = height(r)
+                except Exception:
+                    pass
+            print(json.dumps({"heights": heights}), flush=True)
+            if all(h >= blocks for h in heights):
+                print(json.dumps({"localnet": "ok", "heights": heights}))
+                return
+            time.sleep(1.0)
+        raise SystemExit(f"localnet did not reach height {blocks}: {heights}")
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+# --------------------------------------------------------- signer-harness
+
+
+def run_signer_harness(addr: str, chain_id: str) -> None:
+    """Acceptance checks against a remote signer
+    (reference: tools/tm-signer-harness — ping, pubkey, vote/proposal signing,
+    double-sign refusal).
+
+    The signer must have FRESH sign state (like the reference harness, which
+    loads disposable key/state files): the checks sign at low heights and the
+    double-sign probe advances the signer's watermark. NEVER point this at a
+    production validator's signer."""
+    from tendermint_tpu.crypto import tmhash
+    from tendermint_tpu.privval.file_pv import DoubleSignError
+    from tendermint_tpu.privval.remote import SignerClient
+    from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+    from tendermint_tpu.types.proposal import Proposal
+    from tendermint_tpu.types.vote import Vote
+
+    host, port = parse_hostport(addr, "--addr")
+    client = SignerClient(host, port)
+    results = {}
+
+    def vote(h, tag, t=SignedMsgType.PREVOTE):
+        bh = tmhash.sum256(tag)
+        return Vote(type=t, height=h, round=0,
+                    block_id=BlockID(bh, PartSetHeader(1, tmhash.sum256(bh))),
+                    timestamp_ns=time.time_ns(), validator_address=b"\x01" * 20,
+                    validator_index=0)
+
+    try:
+        client.ping()
+        results["ping"] = "ok"
+        pub = client.get_pub_key()
+        results["pubkey"] = pub.bytes().hex()
+
+        try:
+            signed = client.sign_vote(chain_id, vote(1, b"a"))
+        except DoubleSignError:
+            print(json.dumps({
+                "passed": False,
+                "results": {**results, "sign_vote": "signer state is not fresh "
+                            "(height 1 already signed) — use a disposable signer"},
+            }))
+            raise SystemExit(1)
+        results["sign_vote"] = (
+            "ok" if pub.verify(signed.sign_bytes(chain_id), signed.signature)
+            else "BAD SIGNATURE"
+        )
+
+        try:
+            client.sign_vote(chain_id, vote(1, b"b"))
+            results["double_sign_guard"] = "FAILED: equivocation signed"
+        except DoubleSignError:
+            results["double_sign_guard"] = "ok"
+
+        bh = tmhash.sum256(b"p")
+        prop = Proposal(type=SignedMsgType.PROPOSAL, height=2, round=0,
+                        pol_round=-1, block_id=BlockID(bh, PartSetHeader(1, tmhash.sum256(bh))),
+                        timestamp_ns=time.time_ns())
+        sp = client.sign_proposal(chain_id, prop)
+        results["sign_proposal"] = "ok" if pub.verify(sp.sign_bytes(chain_id), sp.signature) else "BAD SIGNATURE"
+    except (ConnectionError, OSError) as e:
+        print(json.dumps({"passed": False, "results": {**results, "error": str(e)}}))
+        raise SystemExit(1)
+    finally:
+        client.close()
+    ok = all(v == "ok" or k == "pubkey" for k, v in results.items())
+    print(json.dumps({"passed": ok, "results": results}))
+    if not ok:
+        raise SystemExit(1)
+
+
 # ------------------------------------------------------------------ debug
 
 
@@ -253,12 +403,11 @@ def run_light(chain_id: str, primary: str, witnesses: list, trust_height: int,
             if laddr:
                 from tendermint_tpu.light.proxy import LightProxy
 
-                addr = laddr.replace("tcp://", "")
-                if ":" in addr:
-                    host, _, port_s = addr.rpartition(":")
-                else:
-                    host, port_s = addr, "0"
-                proxy = LightProxy(lc, clients[0], host or "127.0.0.1", int(port_s or 0))
+                host, port = parse_hostport(
+                    laddr if ":" in laddr.replace("tcp://", "") else laddr + ":0",
+                    "--laddr",
+                )
+                proxy = LightProxy(lc, clients[0], host, port)
                 await proxy.start()
                 print(json.dumps({"proxy": proxy.addr}), flush=True)
                 stop = asyncio.Event()
@@ -317,6 +466,17 @@ def main(argv=None) -> int:
     sub.add_parser("gen-validator", help="print a fresh validator key (JSON)")
     sub.add_parser("unsafe-reset-all", help="wipe data dir, keep config + keys")
     sub.add_parser("version", help="print version")
+
+    sp = sub.add_parser("localnet", help="generate + run an N-validator localnet as subprocesses")
+    sp.add_argument("--v", type=int, default=4)
+    sp.add_argument("--output-dir", default="./localnet")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-port", type=int, default=26656)
+    sp.add_argument("--blocks", type=int, default=5, help="run until every node reaches this height")
+
+    sp = sub.add_parser("signer-harness", help="acceptance checks against a remote signer")
+    sp.add_argument("--addr", required=True, help="signer address, e.g. tcp://127.0.0.1:26659")
+    sp.add_argument("--chain-id", default="harness-chain")
 
     sp = sub.add_parser(
         "debug", help="capture a debug dump (node state over RPC + config + WAL) into a zip"
@@ -380,6 +540,10 @@ def main(argv=None) -> int:
         if os.path.exists(state_file):
             os.unlink(state_file)
         print(json.dumps({"reset": args.home}))
+    elif args.cmd == "localnet":
+        run_localnet(args.output_dir, args.v, args.chain_id, args.starting_port, args.blocks)
+    elif args.cmd == "signer-harness":
+        run_signer_harness(args.addr, args.chain_id)
     elif args.cmd == "debug":
         debug_dump(args.home, args.rpc, args.output)
         print(json.dumps({"dump": args.output}))

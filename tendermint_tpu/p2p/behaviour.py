@@ -1,0 +1,111 @@
+"""Peer behaviour reporting + trust metric.
+
+reference: behaviour/reporter.go + peer_behaviour.go (thin indirection for
+reactors to report peer conduct -> switch mark/stop) and p2p/trust/metric.go
+(EWMA-ish trust score per peer).
+
+Wiring: the Switch owns a Reporter (switch.reporter); message delivery counts
+as good conduct and receive errors as bad, so every peer carries a live trust
+score (exposed via /net_info). Reactors can report richer conduct directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict
+
+logger = logging.getLogger("tendermint_tpu.p2p")
+
+# behaviour kinds (reference: behaviour/peer_behaviour.go)
+BAD_MESSAGE = "bad_message"
+MESSAGE_OUT_OF_ORDER = "message_out_of_order"
+CONSENSUS_VOTE = "consensus_vote"
+BLOCK_PART = "block_part"
+
+_GOOD = {CONSENSUS_VOTE, BLOCK_PART}
+
+
+@dataclass(frozen=True)
+class PeerBehaviour:
+    peer_id: str
+    kind: str
+    reason: str = ""
+
+    def is_good(self) -> bool:
+        return self.kind in _GOOD
+
+
+class TrustMetric:
+    """Exponentially weighted good/bad ratio in [0, 1]
+    (reference: p2p/trust/metric.go — proportional + integral terms,
+    simplified to a decayed ratio with the same monotonicity)."""
+
+    def __init__(self, decay: float = 0.9):
+        self.decay = decay
+        self.good = 1.0  # optimistic prior (reference starts at 100%)
+        self.bad = 0.0
+        self._last = time.monotonic()
+
+    def _decay_to_now(self) -> None:
+        now = time.monotonic()
+        steps = now - self._last
+        if steps > 0:
+            f = self.decay ** min(steps, 60.0)
+            self.good *= f
+            self.bad *= f
+            self._last = now
+
+    def record_good(self, weight: float = 1.0) -> None:
+        self._decay_to_now()
+        self.good += weight
+
+    def record_bad(self, weight: float = 1.0) -> None:
+        self._decay_to_now()
+        self.bad += weight
+
+    def score(self) -> float:
+        self._decay_to_now()
+        total = self.good + self.bad
+        return self.good / total if total > 0 else 1.0
+
+
+class Reporter:
+    """Routes behaviour reports to the switch: repeated bad conduct stops the
+    peer (reference: behaviour/reporter.go SwitchReporter)."""
+
+    def __init__(self, switch=None, bad_threshold: float = 0.3, history_size: int = 1000):
+        self.switch = switch
+        self.bad_threshold = bad_threshold
+        self.metrics: Dict[str, TrustMetric] = {}
+        self.history: Deque[PeerBehaviour] = deque(maxlen=history_size)
+
+    def metric(self, peer_id: str) -> TrustMetric:
+        m = self.metrics.get(peer_id)
+        if m is None:
+            m = self.metrics[peer_id] = TrustMetric()
+        return m
+
+    async def report(self, pb: PeerBehaviour) -> None:
+        self.history.append(pb)
+        m = self.metric(pb.peer_id)
+        if pb.is_good():
+            m.record_good()
+            return
+        m.record_bad()
+        if self.switch is not None and m.score() < self.bad_threshold:
+            peer = self.switch.peers.get(pb.peer_id)
+            if peer is not None:
+                logger.info(
+                    "peer %s trust %.2f below threshold; disconnecting",
+                    pb.peer_id[:10], m.score(),
+                )
+                await self.switch.stop_peer_for_error(
+                    peer, f"low trust after {pb.kind}: {pb.reason}"
+                )
+
+    def score(self, peer_id: str) -> float:
+        m = self.metrics.get(peer_id)
+        return m.score() if m is not None else 1.0

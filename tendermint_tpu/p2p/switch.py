@@ -28,7 +28,10 @@ RECONNECT_BASE_DELAY = 0.5
 
 class Switch:
     def __init__(self, transport: MultiplexTransport, max_peers: int = 50, metrics=None):
+        from tendermint_tpu.p2p.behaviour import Reporter
+
         self.metrics = metrics
+        self.reporter = Reporter(self)
         self.transport = transport
         self.peers = PeerSet()
         self.reactors: Dict[str, Reactor] = {}
@@ -137,7 +140,12 @@ class Switch:
                 raise ValueError(f"no reactor for channel {chan_id:#x}")
             if self.metrics is not None:
                 self.metrics.peer_receive_bytes_total.labels(f"{chan_id:#x}").inc(len(msg))
-            await reactor.receive(chan_id, peer_holder[0], msg)
+            try:
+                await reactor.receive(chan_id, peer_holder[0], msg)
+            except Exception:
+                self.reporter.metric(peer_holder[0].id).record_bad()
+                raise
+            self.reporter.metric(peer_holder[0].id).record_good(0.05)
 
         async def on_error(e: Exception) -> None:
             await self.stop_peer_for_error(peer_holder[0], e)

@@ -645,6 +645,7 @@ class RPCServer:
                     },
                     "is_outbound": p.outbound,
                     "remote_ip": p.socket_addr,
+                    "trust_score": round(sw.reporter.score(p.id), 4),
                 }
                 for p in sw.peers.list()
             ],

@@ -147,3 +147,74 @@ def test_debug_dump_cli(tmp_path, capsys):
         names = z.namelist()
     assert "config/config.toml" in names
     assert "config/genesis.json" in names
+
+
+def test_behaviour_reporter_and_trust_metric():
+    """Bad conduct decays trust and eventually disconnects the peer
+    (reference models: behaviour/reporter.go, p2p/trust/metric_test.go)."""
+    from tendermint_tpu.p2p.behaviour import (
+        BAD_MESSAGE,
+        CONSENSUS_VOTE,
+        PeerBehaviour,
+        Reporter,
+        TrustMetric,
+    )
+
+    m = TrustMetric()
+    assert m.score() == 1.0
+    for _ in range(3):
+        m.record_good()
+    assert m.score() > 0.9
+    for _ in range(10):
+        m.record_bad()
+    assert m.score() < 0.5
+
+    class FakeSwitch:
+        def __init__(self):
+            self.stopped = []
+
+            class Peers:
+                def __init__(self, outer):
+                    self.outer = outer
+
+                def get(self, pid):
+                    return pid  # any truthy token
+
+            self.peers = Peers(self)
+
+        async def stop_peer_for_error(self, peer, reason):
+            self.stopped.append((peer, str(reason)))
+
+    async def go():
+        sw = FakeSwitch()
+        rep = Reporter(sw)
+        await rep.report(PeerBehaviour("peer-1", CONSENSUS_VOTE))
+        assert sw.stopped == []
+        for _ in range(12):
+            await rep.report(PeerBehaviour("peer-1", BAD_MESSAGE, "garbage"))
+        assert sw.stopped and sw.stopped[0][0] == "peer-1"
+        assert rep.score("peer-1") < 0.3
+        assert rep.score("unknown") == 1.0
+
+    asyncio.run(go())
+
+
+def test_signer_harness_cli(capsys):
+    from tendermint_tpu.cli.main import main
+    from tendermint_tpu.crypto.keys import gen_ed25519
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.privval.remote import SignerServer
+
+    pv = FilePV(gen_ed25519(b"\x61" * 32))
+    server = SignerServer(pv, "harness-chain")
+    server.start()
+    try:
+        rc = main(["signer-harness", "--addr", f"tcp://127.0.0.1:{server.addr[1]}"])
+        assert rc == 0
+        import json as _json
+
+        out = _json.loads(capsys.readouterr().out)
+        assert out["passed"] is True
+        assert out["results"]["double_sign_guard"] == "ok"
+    finally:
+        server.stop()
