@@ -36,12 +36,13 @@ def _config_path(home: str) -> str:
 
 
 def parse_hostport(addr: str, what: str = "address") -> tuple:
-    """'tcp://host:port' / 'host:port' -> (host, port) with a usage-grade error."""
+    """'tcp://host:port' / 'host:port' -> (host, port) with a usage-grade
+    error. An empty host (e.g. 'tcp://:8888') defaults to 127.0.0.1."""
     bare = addr.replace("tcp://", "")
-    host, _, port_s = bare.rpartition(":")
-    if not host or not port_s.isdigit():
+    host, sep, port_s = bare.rpartition(":")
+    if not sep or not port_s.isdigit():
         raise SystemExit(f"{what} must look like tcp://host:port, got {addr!r}")
-    return host, int(port_s)
+    return host or "127.0.0.1", int(port_s)
 
 
 def load_home(home: str) -> Config:

@@ -40,6 +40,8 @@ class RPCConfig:
     max_subscriptions_per_client: int = 5
     timeout_broadcast_tx_commit: float = 10.0
     max_body_bytes: int = 1000000
+    # unlocks the unsafe_* routes (reference: rpc.unsafe in config.toml)
+    unsafe: bool = False
 
 
 @dataclass
@@ -65,6 +67,7 @@ class P2PConfig:
 
 @dataclass
 class MempoolConfig:
+    wal_dir: str = ""  # empty disables the mempool WAL (reference default)
     recheck: bool = True
     broadcast: bool = True
     size: int = 5000

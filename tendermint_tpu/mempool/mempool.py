@@ -47,8 +47,12 @@ class Mempool:
         keep_invalid_txs_in_cache: bool = False,
         recheck: bool = True,
         metrics=None,
+        wal_path: str = "",
     ):
         self.metrics = metrics
+        self._wal = None
+        if wal_path:
+            self.init_wal(wal_path)
         self.proxy_app = proxy_app
         self.max_txs = max_txs
         self.max_txs_bytes = max_txs_bytes
@@ -82,11 +86,47 @@ class Mempool:
     def is_full(self, tx_len: int) -> bool:
         return len(self._txs) >= self.max_txs or self._total_bytes + tx_len > self.max_txs_bytes
 
+    WAL_MAX_BYTES = 64 * 1024 * 1024  # rotate beyond this (autofile-group role)
+
+    def init_wal(self, path: str) -> None:
+        """Append-only tx log for crash forensics (reference:
+        mempool/clist_mempool.go InitWAL over libs/autofile; records are
+        4-byte big-endian length + tx bytes; one .old generation is kept,
+        standing in for the reference's rotating autofile group)."""
+        import os as _os
+
+        _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+        self._wal_path = path
+        self._wal = open(path, "ab")
+
+    def close_wal(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
+
+    def _wal_write(self, tx: bytes) -> None:
+        # caller holds self._lock
+        if self._wal is None:
+            return
+        self._wal.write(len(tx).to_bytes(4, "big") + tx)
+        self._wal.flush()
+        if self._wal.tell() > self.WAL_MAX_BYTES:
+            import os as _os
+
+            self._wal.close()
+            _os.replace(self._wal_path, self._wal_path + ".old")
+            self._wal = open(self._wal_path, "ab")
+
     def flush(self) -> None:
         with self._lock:
             self._txs.clear()
             self._cache.clear()
             self._total_bytes = 0
+            # allow the next admitted tx to re-notify consensus — without this
+            # a flush between notify and commit stalls proposal creation when
+            # create_empty_blocks is off
+            self._notified_txs_available = False
 
     # -- notifications ------------------------------------------------------
 
@@ -137,6 +177,7 @@ class Mempool:
                         senders=frozenset({sender}) if sender else frozenset(),
                     )
                     self._total_bytes += len(tx)
+                    self._wal_write(tx)
                     self._notify_txs_available()
             else:
                 if not self.keep_invalid_txs_in_cache:

@@ -120,6 +120,11 @@ class Node:
             keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
             recheck=config.mempool.recheck,
             metrics=self.metrics.mempool,
+            wal_path=(
+                os.path.join(config.root_dir, config.mempool.wal_dir, "wal")
+                if config.mempool.wal_dir and config.root_dir
+                else ""
+            ),
         )
 
         # evidence pool
@@ -342,6 +347,7 @@ class Node:
         await self.indexer_service.stop()
         if self._owns_priv_validator:
             self.priv_validator.close()
+        self.mempool.close_wal()
         self.proxy_app.stop()
         for db in (self.block_db, self.state_db, self.evidence_db):
             db.close()

@@ -82,9 +82,13 @@ class Reporter:
         self.metrics: Dict[str, TrustMetric] = {}
         self.history: Deque[PeerBehaviour] = deque(maxlen=history_size)
 
+    MAX_TRACKED = 4096  # node ids are attacker-generated; bound the map
+
     def metric(self, peer_id: str) -> TrustMetric:
         m = self.metrics.get(peer_id)
         if m is None:
+            while len(self.metrics) >= self.MAX_TRACKED:
+                self.metrics.pop(next(iter(self.metrics)))
             m = self.metrics[peer_id] = TrustMetric()
         return m
 

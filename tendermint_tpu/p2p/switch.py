@@ -142,8 +142,14 @@ class Switch:
                 self.metrics.peer_receive_bytes_total.labels(f"{chan_id:#x}").inc(len(msg))
             try:
                 await reactor.receive(chan_id, peer_holder[0], msg)
-            except Exception:
-                self.reporter.metric(peer_holder[0].id).record_bad()
+            except Exception as e:
+                # full report: records bad conduct AND applies the trust
+                # threshold (the peer is usually also stopped by on_error)
+                from tendermint_tpu.p2p.behaviour import BAD_MESSAGE, PeerBehaviour
+
+                await self.reporter.report(
+                    PeerBehaviour(peer_holder[0].id, BAD_MESSAGE, str(e))
+                )
                 raise
             self.reporter.metric(peer_holder[0].id).record_good(0.05)
 
@@ -180,6 +186,10 @@ class Switch:
 
     async def _stop_and_remove_peer(self, peer: Peer, reason) -> None:
         self.peers.remove(peer.id)
+        # keep bad reputations (reconnecting with the same id stays scored),
+        # drop good ones so the metrics map doesn't grow with peer churn
+        if self.reporter.score(peer.id) > 0.8:
+            self.reporter.metrics.pop(peer.id, None)
         if self.metrics is not None:
             self.metrics.peers.set(self.peers.size())
         await peer.stop()

@@ -79,6 +79,8 @@ class RPCServer:
             "block_by_hash": self._block_by_hash,
             "broadcast_evidence": self._broadcast_evidence,
             "dial_peers": self._dial_peers,
+            "dial_seeds": self._dial_seeds,
+            "unsafe_flush_mempool": self._unsafe_flush_mempool,
         }
 
     async def start(self) -> None:
@@ -617,8 +619,28 @@ class RPCServer:
         self.node.evidence_pool.add_evidence(ev)
         return {"hash": ev.hash().hex().upper()}
 
+    def _require_unsafe(self) -> None:
+        if not self.node.config.rpc.unsafe:
+            raise ValueError("unsafe RPC routes are disabled (set rpc.unsafe = true)")
+
+    async def _dial_seeds(self, params) -> dict:
+        """unsafe route (reference: rpc/core/net.go UnsafeDialSeeds)."""
+        self._require_unsafe()
+        seeds = params.get("seeds") or []
+        if self.node.switch is None:
+            raise ValueError("p2p is not enabled")
+        await self.node.switch.dial_peers_async(list(seeds), persistent=False)
+        return {"log": f"dialing seeds: {seeds}"}
+
+    async def _unsafe_flush_mempool(self, params) -> dict:
+        """unsafe route (reference: rpc/core/mempool.go UnsafeFlushMempool)."""
+        self._require_unsafe()
+        self.node.mempool.flush()
+        return {}
+
     async def _dial_peers(self, params) -> dict:
         """unsafe route (reference: rpc/core/net.go UnsafeDialPeers)."""
+        self._require_unsafe()
         if self.node.switch is None:
             raise ValueError("p2p is not enabled")
         peers = params.get("peers", [])

@@ -1,0 +1,137 @@
+"""Evidence pool unit tests: add/check/pending/update/expiry/committed dedup
+(reference test model: evidence/pool_test.go, evidence/verify_test.go)."""
+
+import os
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.crypto import gen_ed25519, tmhash
+from tendermint_tpu.evidence.pool import EvidenceError, EvidencePool
+from tendermint_tpu.libs.kvdb import MemDB
+from tendermint_tpu.state.sm_state import state_from_genesis
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.types.basic import NANOS, BlockID, PartSetHeader, SignedMsgType
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.vote import Vote
+
+CHAIN = "ev-chain"
+
+
+def make_env():
+    priv = gen_ed25519(b"\x31" * 32)
+    gen = GenesisDoc(chain_id=CHAIN, validators=[GenesisValidator(priv.pub_key(), 10)])
+    gen.validate_and_complete()
+    state = state_from_genesis(gen)
+    state_store = StateStore(MemDB())
+    state_store.save(state)  # persists the valset at heights 1, 2
+    pool = EvidencePool(MemDB(), state_store, block_store=None)
+    return priv, state, state_store, pool
+
+
+def make_equivocation(priv, height=1, total_power=10, val_power=10, ts=None):
+    ts = ts if ts is not None else 1_700_000_000 * NANOS
+
+    def vote(tag):
+        bh = tmhash.sum256(tag)
+        v = Vote(
+            type=SignedMsgType.PRECOMMIT,
+            height=height,
+            round=0,
+            block_id=BlockID(bh, PartSetHeader(1, tmhash.sum256(bh))),
+            timestamp_ns=ts,
+            validator_address=priv.pub_key().address(),
+            validator_index=0,
+        )
+        return v.with_signature(priv.sign(v.sign_bytes(CHAIN)))
+
+    return DuplicateVoteEvidence.from_votes(
+        vote(b"block-A"), vote(b"block-B"), ts, total_power, val_power
+    )
+
+
+def test_add_check_and_pending_lifecycle():
+    priv, state, _, pool = make_env()
+    import dataclasses
+
+    state = dataclasses.replace(
+        state, last_block_height=1, last_block_time_ns=1_700_000_100 * NANOS
+    )
+    pool.set_state(state)
+    ev = make_equivocation(priv)
+
+    pool.add_evidence(ev)
+    assert pool.is_pending(ev)
+    assert not pool.is_committed(ev)
+    assert pool.pending_evidence(1 << 20) != []
+
+    # idempotent re-add
+    pool.add_evidence(ev)
+    assert len(pool.pending_evidence(1 << 20)) == 1
+
+    # commit: moves pending -> committed; re-add becomes a no-op
+    pool.update(state, [ev])
+    assert pool.is_committed(ev)
+    assert not pool.is_pending(ev)
+    assert pool.pending_evidence(1 << 20) == []
+    with pytest.raises(EvidenceError):
+        pool.check_evidence(state, ev)  # committed evidence is rejected
+
+
+def test_bad_evidence_rejected():
+    priv, state, _, pool = make_env()
+    import dataclasses
+
+    state = dataclasses.replace(
+        state, last_block_height=1, last_block_time_ns=1_700_000_100 * NANOS
+    )
+    pool.set_state(state)
+
+    # wrong validator power claimed
+    with pytest.raises(EvidenceError):
+        pool.add_evidence(make_equivocation(priv, val_power=99))
+    # total power mismatch
+    with pytest.raises(EvidenceError):
+        pool.add_evidence(make_equivocation(priv, total_power=99))
+    # validator not in the set
+    outsider = gen_ed25519(b"\x32" * 32)
+    with pytest.raises(EvidenceError):
+        pool.add_evidence(make_equivocation(outsider))
+    # forged signature: evidence verify fails
+    ev = make_equivocation(priv)
+    import dataclasses as dc
+
+    forged = dc.replace(ev, vote_b=dc.replace(ev.vote_b, signature=b"\x00" * 64))
+    with pytest.raises(Exception):
+        pool.add_evidence(forged)
+
+
+def test_expired_evidence_rejected_and_pruned():
+    priv, state, _, pool = make_env()
+    import dataclasses
+
+    params = state.consensus_params
+    old_ts = 1_000_000_000 * NANOS
+    ev = make_equivocation(priv, ts=old_ts)
+
+    # state far in the future: exceed BOTH age bounds
+    future = dataclasses.replace(
+        state,
+        last_block_height=1 + params.evidence.max_age_num_blocks + 1,
+        last_block_time_ns=old_ts + params.evidence.max_age_duration_ns + NANOS,
+    )
+    pool.set_state(future)
+    with pytest.raises(EvidenceError):
+        pool.add_evidence(ev)
+
+    # pending evidence that expires later gets pruned by update()
+    fresh = dataclasses.replace(
+        state, last_block_height=1, last_block_time_ns=old_ts + NANOS
+    )
+    pool.set_state(fresh)
+    pool.add_evidence(make_equivocation(priv, ts=old_ts))
+    assert pool.pending_evidence(1 << 20)
+    pool.update(future, [])
+    assert pool.pending_evidence(1 << 20) == []

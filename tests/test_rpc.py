@@ -147,3 +147,51 @@ def test_broadcast_evidence_route(tmp_path):
             await node.stop()
 
     asyncio.run(run())
+
+
+def test_unsafe_routes_gated_and_mempool_wal(tmp_path):
+    """dial_seeds/unsafe_flush_mempool refuse unless rpc.unsafe=true; the
+    mempool WAL logs admitted txs (reference: rpc/core/net.go UnsafeDialSeeds,
+    mempool InitWAL)."""
+
+    async def run():
+        node = make_node(tmp_path)
+        await node.start()
+        try:
+            client = LocalClient(node)
+            try:
+                await client.call("unsafe_flush_mempool")
+                assert False, "unsafe route should be gated"
+            except Exception as e:
+                assert "unsafe" in str(e)
+            node.config.rpc.unsafe = True
+            node.mempool.check_tx(b"w=1")
+            assert node.mempool.size() == 1
+            await client.call("unsafe_flush_mempool")
+            assert node.mempool.size() == 0
+        finally:
+            await node.stop()
+
+        # mempool WAL records admitted txs
+        from tendermint_tpu.mempool.mempool import Mempool
+
+        class OkApp:
+            def check_tx(self, req):
+                from tendermint_tpu.abci import types as abci
+
+                return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK)
+
+        wal = str(tmp_path / "mwal" / "wal")
+        mp = Mempool(OkApp(), wal_path=wal)
+        mp.check_tx(b"tx-one")
+        mp.check_tx(b"tx-two")
+        mp.close_wal()
+        raw = open(wal, "rb").read()
+        txs = []
+        while raw:
+            n = int.from_bytes(raw[:4], "big")
+            txs.append(raw[4 : 4 + n])
+            raw = raw[4 + n :]
+        assert txs == [b"tx-one", b"tx-two"]
+
+    asyncio.run(run())
