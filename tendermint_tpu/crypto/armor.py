@@ -1,0 +1,117 @@
+"""ASCII armor + passphrase-encrypted private keys.
+
+reference: crypto/armor (armor.go — RFC-4880-style armored blocks) and
+crypto/xsalsa20symmetric + the keys armoring in the SDK: encrypt with a key
+derived from a passphrase, armor the ciphertext. Cipher here is
+XChaCha20-Poly1305 (the reference tree also ships crypto/xchacha20poly1305);
+KDF is scrypt with the parameters carried in the armor headers so they can
+evolve without breaking old files.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from typing import Dict, Tuple
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.scrypt import Scrypt
+
+ARMOR_START = "-----BEGIN TENDERMINT {}-----"
+ARMOR_END = "-----END TENDERMINT {}-----"
+
+# scrypt cost parameters (interactive-login grade)
+_SCRYPT_N = 1 << 15
+_SCRYPT_R = 8
+_SCRYPT_P = 1
+
+
+class ArmorError(Exception):
+    pass
+
+
+def encode_armor(block_type: str, headers: Dict[str, str], data: bytes) -> str:
+    """reference: crypto/armor/armor.go EncodeArmor."""
+    lines = [ARMOR_START.format(block_type)]
+    for k in sorted(headers):
+        lines.append(f"{k}: {headers[k]}")
+    lines.append("")
+    b64 = base64.b64encode(data).decode()
+    lines.extend(b64[i : i + 64] for i in range(0, len(b64), 64))
+    lines.append(ARMOR_END.format(block_type))
+    return "\n".join(lines) + "\n"
+
+
+def decode_armor(text: str) -> Tuple[str, Dict[str, str], bytes]:
+    """reference: crypto/armor/armor.go DecodeArmor."""
+    lines = [l.strip() for l in text.strip().splitlines()]
+    if not lines or not lines[0].startswith("-----BEGIN TENDERMINT "):
+        raise ArmorError("missing armor start line")
+    block_type = lines[0][len("-----BEGIN TENDERMINT ") : -len("-----")]
+    if lines[-1] != ARMOR_END.format(block_type):
+        raise ArmorError("missing or mismatched armor end line")
+    headers: Dict[str, str] = {}
+    body_start = 1
+    for i, line in enumerate(lines[1:-1], start=1):
+        if not line:
+            body_start = i + 1
+            break
+        if ":" not in line:
+            body_start = i
+            break
+        k, _, v = line.partition(":")
+        headers[k.strip()] = v.strip()
+    else:
+        body_start = len(lines) - 1
+    try:
+        data = base64.b64decode("".join(lines[body_start:-1]))
+    except Exception as e:
+        raise ArmorError(f"bad armor body: {e}") from e
+    return block_type, headers, data
+
+
+def _derive(passphrase: str, salt: bytes, n: int) -> bytes:
+    return Scrypt(salt=salt, length=32, n=n, r=_SCRYPT_R, p=_SCRYPT_P).derive(
+        passphrase.encode()
+    )
+
+
+def encrypt_armor_priv_key(priv_key_bytes: bytes, passphrase: str,
+                           key_type: str = "ed25519") -> str:
+    """Armored, passphrase-encrypted private key
+    (reference: the SDK's EncryptArmorPrivKey over crypto/armor)."""
+    salt = os.urandom(16)
+    nonce = os.urandom(12)
+    key = _derive(passphrase, salt, _SCRYPT_N)
+    ct = ChaCha20Poly1305(key).encrypt(nonce, priv_key_bytes, None)
+    headers = {
+        "kdf": "scrypt",
+        "n": str(_SCRYPT_N),
+        "salt": salt.hex().upper(),
+        "nonce": nonce.hex().upper(),
+        "type": key_type,
+    }
+    return encode_armor("PRIVATE KEY", headers, ct)
+
+
+def unarmor_decrypt_priv_key(armor_text: str, passphrase: str) -> Tuple[bytes, str]:
+    """Returns (priv_key_bytes, key_type). Raises ArmorError on a wrong
+    passphrase or tampered armor."""
+    block_type, headers, ct = decode_armor(armor_text)
+    if block_type != "PRIVATE KEY":
+        raise ArmorError(f"unexpected armor type {block_type!r}")
+    if headers.get("kdf") != "scrypt":
+        raise ArmorError(f"unsupported KDF {headers.get('kdf')!r}")
+    try:
+        salt = bytes.fromhex(headers["salt"])
+        nonce = bytes.fromhex(headers["nonce"])
+        n = int(headers.get("n", _SCRYPT_N))
+    except (KeyError, ValueError) as e:
+        raise ArmorError(f"bad armor headers: {e}") from e
+    key = _derive(passphrase, salt, n)
+    try:
+        pt = ChaCha20Poly1305(key).decrypt(nonce, ct, None)
+    except InvalidTag:
+        raise ArmorError("wrong passphrase or corrupted armor") from None
+    return pt, headers.get("type", "ed25519")

@@ -93,3 +93,44 @@ def test_pubkey_equality_and_bad_sizes():
         Ed25519PubKey(b"short")
     with pytest.raises(ValueError):
         Ed25519PrivKey(b"short")
+
+
+def test_armor_roundtrip_and_tamper():
+    """ASCII armor + passphrase encryption for private keys
+    (reference models: crypto/armor/armor_test.go + SDK armor tests)."""
+    import pytest
+
+    from tendermint_tpu.crypto.armor import (
+        ArmorError,
+        decode_armor,
+        encode_armor,
+        encrypt_armor_priv_key,
+        unarmor_decrypt_priv_key,
+    )
+    from tendermint_tpu.crypto.keys import gen_ed25519
+
+    # generic armor round-trip
+    armored = encode_armor("MESSAGE", {"k": "v"}, b"\x00\x01payload\xff")
+    bt, headers, data = decode_armor(armored)
+    assert (bt, headers["k"], data) == ("MESSAGE", "v", b"\x00\x01payload\xff")
+
+    # encrypted key round-trip
+    priv = gen_ed25519(b"\x77" * 32)
+    text = encrypt_armor_priv_key(priv.bytes(), "hunter2")
+    got, key_type = unarmor_decrypt_priv_key(text, "hunter2")
+    assert got == priv.bytes()
+    assert key_type == "ed25519"
+
+    # wrong passphrase
+    with pytest.raises(ArmorError):
+        unarmor_decrypt_priv_key(text, "wrong")
+    # tampered body
+    lines = text.splitlines()
+    body_i = next(i for i, l in enumerate(lines) if l == "") + 1
+    ch = "A" if lines[body_i][0] != "A" else "B"
+    lines[body_i] = ch + lines[body_i][1:]
+    with pytest.raises(ArmorError):
+        unarmor_decrypt_priv_key("\n".join(lines), "hunter2")
+    # truncated armor
+    with pytest.raises(ArmorError):
+        decode_armor("not armor at all")

@@ -65,7 +65,7 @@ def test_four_validator_net_commits_blocks(tmp_path):
         try:
             await start_and_connect(nodes)
             # all four must reach height 5 (needs +2/3 from 3+ validators)
-            await asyncio.gather(*(n.wait_for_height(5, timeout=60) for n in nodes))
+            await asyncio.gather(*(n.wait_for_height(5, timeout=180) for n in nodes))
             # chains agree
             h = min(n.block_store.height for n in nodes)
             assert h >= 5
@@ -86,7 +86,7 @@ def test_net_commits_txs_via_gossip(tmp_path):
         nodes = make_net(3, tmp_path, chain="gossip-chain")
         try:
             await start_and_connect(nodes)
-            await asyncio.gather(*(n.wait_for_height(1, timeout=60) for n in nodes))
+            await asyncio.gather(*(n.wait_for_height(1, timeout=180) for n in nodes))
             # submit the tx to node 2 only; mempool gossip must carry it to the
             # proposer eventually
             nodes[2].mempool.check_tx(b"gossip=works")
@@ -124,13 +124,13 @@ def test_node_catches_up_after_late_join(tmp_path):
                     )
             # 3 of 4 validators = 30/40 power: exactly +2/3 is NOT enough
             # (strictly greater needed: 30*3 > 40*2 holds, 90 > 80 — ok, blocks flow)
-            await asyncio.gather(*(n.wait_for_height(3, timeout=60) for n in early))
+            await asyncio.gather(*(n.wait_for_height(3, timeout=180) for n in early))
             # now the 4th joins
             await late.start()
             await late.switch.dial_peers_async(
                 [f"{early[0].node_key.id}@{early[0].p2p_addr}"], persistent=True
             )
-            await late.wait_for_height(3, timeout=60)
+            await late.wait_for_height(3, timeout=180)
             assert late.block_store.height >= 3
             b = late.block_store.load_block(2)
             assert b.hash() == early[0].block_store.load_block(2).hash()
