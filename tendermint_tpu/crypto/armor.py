@@ -2,10 +2,12 @@
 
 reference: crypto/armor (armor.go — RFC-4880-style armored blocks) and
 crypto/xsalsa20symmetric + the keys armoring in the SDK: encrypt with a key
-derived from a passphrase, armor the ciphertext. Cipher here is
-XChaCha20-Poly1305 (the reference tree also ships crypto/xchacha20poly1305);
-KDF is scrypt with the parameters carried in the armor headers so they can
-evolve without breaking old files.
+derived from a passphrase, armor the ciphertext. Cipher here is IETF
+ChaCha20-Poly1305 with a random 96-bit nonce — safe because every encryption
+derives a FRESH key from a fresh salt (the reference tree ships
+crypto/xchacha20poly1305; extended nonces buy nothing under per-use keys);
+KDF is scrypt with ALL cost parameters (n, r, p) carried in the armor
+headers so they can evolve without breaking old files.
 """
 
 from __future__ import annotations
@@ -71,10 +73,11 @@ def decode_armor(text: str) -> Tuple[str, Dict[str, str], bytes]:
     return block_type, headers, data
 
 
-def _derive(passphrase: str, salt: bytes, n: int) -> bytes:
-    return Scrypt(salt=salt, length=32, n=n, r=_SCRYPT_R, p=_SCRYPT_P).derive(
-        passphrase.encode()
-    )
+_SCRYPT_N_MAX = 1 << 21  # ~256MB with r=8: DoS ceiling for untrusted armor
+
+
+def _derive(passphrase: str, salt: bytes, n: int, r: int, p: int) -> bytes:
+    return Scrypt(salt=salt, length=32, n=n, r=r, p=p).derive(passphrase.encode())
 
 
 def encrypt_armor_priv_key(priv_key_bytes: bytes, passphrase: str,
@@ -83,11 +86,13 @@ def encrypt_armor_priv_key(priv_key_bytes: bytes, passphrase: str,
     (reference: the SDK's EncryptArmorPrivKey over crypto/armor)."""
     salt = os.urandom(16)
     nonce = os.urandom(12)
-    key = _derive(passphrase, salt, _SCRYPT_N)
+    key = _derive(passphrase, salt, _SCRYPT_N, _SCRYPT_R, _SCRYPT_P)
     ct = ChaCha20Poly1305(key).encrypt(nonce, priv_key_bytes, None)
     headers = {
         "kdf": "scrypt",
         "n": str(_SCRYPT_N),
+        "r": str(_SCRYPT_R),
+        "p": str(_SCRYPT_P),
         "salt": salt.hex().upper(),
         "nonce": nonce.hex().upper(),
         "type": key_type,
@@ -107,9 +112,20 @@ def unarmor_decrypt_priv_key(armor_text: str, passphrase: str) -> Tuple[bytes, s
         salt = bytes.fromhex(headers["salt"])
         nonce = bytes.fromhex(headers["nonce"])
         n = int(headers.get("n", _SCRYPT_N))
+        r = int(headers.get("r", _SCRYPT_R))
+        p = int(headers.get("p", _SCRYPT_P))
     except (KeyError, ValueError) as e:
         raise ArmorError(f"bad armor headers: {e}") from e
-    key = _derive(passphrase, salt, n)
+    # validate untrusted parameters BEFORE deriving: a hostile armor file
+    # must not be able to demand gigabytes of scrypt memory or smuggle a
+    # ValueError past the ArmorError contract
+    if not (1 < n <= _SCRYPT_N_MAX) or n & (n - 1):
+        raise ArmorError(f"scrypt n {n} out of range or not a power of two")
+    if not (0 < r <= 32 and 0 < p <= 16):
+        raise ArmorError(f"scrypt r/p out of range: r={r} p={p}")
+    if len(nonce) != 12 or len(salt) != 16:
+        raise ArmorError("bad salt/nonce length")
+    key = _derive(passphrase, salt, n, r, p)
     try:
         pt = ChaCha20Poly1305(key).decrypt(nonce, ct, None)
     except InvalidTag:

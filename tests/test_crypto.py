@@ -134,3 +134,32 @@ def test_armor_roundtrip_and_tamper():
     # truncated armor
     with pytest.raises(ArmorError):
         decode_armor("not armor at all")
+
+
+def test_armor_rejects_hostile_headers():
+    """Untrusted armor cannot demand huge scrypt memory or escape the
+    ArmorError contract."""
+    import pytest
+
+    from tendermint_tpu.crypto.armor import (
+        ArmorError,
+        encrypt_armor_priv_key,
+        unarmor_decrypt_priv_key,
+    )
+    from tendermint_tpu.crypto.keys import gen_ed25519
+
+    text = encrypt_armor_priv_key(gen_ed25519(b"\x78" * 32).bytes(), "pw")
+
+    def with_header(k, v):
+        out = []
+        for line in text.splitlines():
+            if line.startswith(f"{k}:"):
+                out.append(f"{k}: {v}")
+            else:
+                out.append(line)
+        return "\n".join(out)
+
+    for k, v in (("n", "1073741824"), ("n", "3"), ("n", "x"),
+                 ("r", "9999"), ("nonce", "AB"), ("salt", "CD")):
+        with pytest.raises(ArmorError):
+            unarmor_decrypt_priv_key(with_header(k, v), "pw")
