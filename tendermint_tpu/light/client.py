@@ -46,6 +46,7 @@ class ErrConflictingHeaders(LightError):
     def __init__(self, witness_index: int, height: int):
         self.witness_index = witness_index
         self.height = height
+        self.conflicting_blocks: list = []
         super().__init__(f"witness #{witness_index} has a different header at height {height}")
 
 
@@ -98,6 +99,10 @@ class Client:
         self.trust_options = trust_options
         self.primary = primary
         self.witnesses = list(witnesses)
+        # Conflicting headers retained after divergence detection, for
+        # operator inspection / evidence submission (see
+        # _compare_with_witnesses).
+        self.conflicting_blocks: list = []
         self.store = trusted_store
         self.mode = verification_mode
         self.trust_level = trust_level
@@ -316,11 +321,27 @@ class Client:
             except ProviderError:
                 continue
             if other.hash() != lb.hash():
-                conflicts.append((i, w))
+                conflicts.append((i, w, other))
         if conflicts:
-            for _, w in conflicts:
+            # Keep the conflicting evidence available for operator
+            # inspection (the reference builds LightClientAttackEvidence and
+            # reports it to the honest providers, light/detector.go:116; we
+            # record the diverging headers and surface them on the error).
+            for i, w, other in conflicts:
+                logger.error(
+                    "witness %s reports conflicting header at height %d: "
+                    "primary hash %s vs witness hash %s — possible light-client attack",
+                    w,
+                    lb.height,
+                    lb.hash().hex(),
+                    other.hash().hex(),
+                )
+                self.conflicting_blocks.append(other)
+            for _, w, _other in conflicts:
                 self.witnesses.remove(w)
-            raise ErrConflictingHeaders(conflicts[0][0], lb.height)
+            err = ErrConflictingHeaders(conflicts[0][0], lb.height)
+            err.conflicting_blocks = [c[2] for c in conflicts]
+            raise err
 
     async def _fetch_from_primary(self, height: Optional[int]) -> LightBlock:
         """Fetch from primary, replacing it with a witness on failure

@@ -38,36 +38,71 @@ def make_mesh(devices=None, shape=None, axis_names=("vals",)) -> Mesh:
     return Mesh(arr, axis_names)
 
 
+def _aligned(mesh: Mesh, batch_rank: int):
+    """Right-align mesh axes onto the trailing batch axes.
+
+    Returns (leading_none_count, batch_spec_axes): a batch of rank R >= M
+    (mesh rank) maps its LAST M axes onto the mesh axes and leaves the
+    leading R-M axes unsharded — matching the documented semantics (the
+    previous zip() left-aligned and silently truncated; advisor r2 finding).
+    """
+    mesh_rank = len(mesh.axis_names)
+    if batch_rank < mesh_rank:
+        raise ValueError(
+            f"batch rank {batch_rank} < mesh rank {mesh_rank}: "
+            "every mesh axis needs a batch axis to shard"
+        )
+    lead = batch_rank - mesh_rank
+    return (None,) * lead + tuple(mesh.axis_names)
+
+
+def _shard_batch_shape(mesh: Mesh, batch_shape) -> tuple:
+    """Per-device shard of a right-aligned batch shape."""
+    spec = _aligned(mesh, len(batch_shape))
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return tuple(
+        d // sizes[ax] if ax is not None else d for d, ax in zip(batch_shape, spec)
+    )
+
+
 def sharded_verify(mesh: Mesh):
     """jit'd verify_prepared with the batch axis sharded across the mesh.
 
     Inputs [32,B]/[253,B] (or [..., NB, NV] for 2D meshes); batch axes map to
     mesh axes right-aligned: the last input axis onto the last mesh axis, etc.
-    Returns the bool mask with the same sharded layout.
+    (extra leading batch axes stay unsharded). Returns the bool mask with the
+    same sharded layout.
     """
-    spec_in = P(None, *mesh.axis_names)
-    spec_out = P(*mesh.axis_names)
     # ctx is replicated: every chip gets the same materialized constants
     # sized for ITS shard, so the fast (real-buffer) path runs per shard.
     spec_ctx = jax.tree.map(lambda _: P(), make_ctx(()))
+    _cache: dict = {}
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(spec_in, spec_in, spec_in, spec_in, spec_ctx),
-        out_specs=spec_out,
-        check_vma=False,
-    )
-    def _verify(a, r, s_bits, h_bits, ctx):
-        return _verify_core(a, r, s_bits, h_bits, ctx)
+    def _for_rank(batch_rank: int):
+        fn = _cache.get(batch_rank)
+        if fn is None:
+            batch_axes = _aligned(mesh, batch_rank)
+            spec_in = P(None, *batch_axes)
+            spec_out = P(*batch_axes)
 
-    jitted = jax.jit(_verify)
+            @partial(
+                jax.shard_map,
+                mesh=mesh,
+                in_specs=(spec_in, spec_in, spec_in, spec_in, spec_ctx),
+                out_specs=spec_out,
+                check_vma=False,
+            )
+            def _verify(a, r, s_bits, h_bits, ctx):
+                return _verify_core(a, r, s_bits, h_bits, ctx)
+
+            fn = _cache[batch_rank] = jax.jit(_verify)
+        return fn
 
     def run(a, r, s_bits, h_bits):
-        shard_batch = tuple(
-            d // m for d, m in zip(a.shape[1:], mesh.devices.shape)
+        shard_batch = _shard_batch_shape(mesh, a.shape[1:])
+        return _for_rank(len(a.shape) - 1)(
+            a, r, s_bits, h_bits, make_ctx(shard_batch)
         )
-        return jitted(a, r, s_bits, h_bits, make_ctx(shard_batch))
 
     return run
 
@@ -80,41 +115,47 @@ def sharded_commit_step(mesh: Mesh):
     (reference: types/validator_set.go:662 VerifyCommit tally semantics).
     Returns (mask, ok) with mask sharded and ok replicated.
     """
-    spec_in = P(None, *mesh.axis_names)
-    spec_p = P(*mesh.axis_names)
+    spec_ctx = jax.tree.map(lambda _: P(), make_ctx(()))
+    _cache: dict = {}
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(spec_in, spec_in, spec_in, spec_in, spec_in,
-                  jax.tree.map(lambda _: P(), make_ctx(()))),
-        out_specs=(spec_p, P(), P()),
-        check_vma=False,
-    )
-    def _step(a, r, s_bits, h_bits, power_planes, ctx):
-        mask = _verify_core(a, r, s_bits, h_bits, ctx)
-        # Exact int64 tallies without x64: powers arrive as four uint32 planes
-        # of 16 bits each (see split_powers). Each plane sum is bounded by
-        # N*2^16, safe in uint32 for N up to 2^15 validators per shard; psum
-        # across the mesh and recombine host-side in Python ints (reference
-        # tally semantics: types/validator_set.go:662 uses int64 power).
-        valid_planes = jnp.where(mask[None], power_planes, 0)
-        talled = jnp.sum(valid_planes, axis=tuple(range(1, valid_planes.ndim)))
-        total = jnp.sum(power_planes, axis=tuple(range(1, power_planes.ndim)))
-        for ax in mesh.axis_names:
-            talled = jax.lax.psum(talled, ax)
-            total = jax.lax.psum(total, ax)
-        return mask, talled, total
+    def _for_rank(batch_rank: int):
+        fn = _cache.get(batch_rank)
+        if fn is None:
+            batch_axes = _aligned(mesh, batch_rank)
+            spec_in = P(None, *batch_axes)
+            spec_p = P(*batch_axes)
 
-    stepped = jax.jit(_step)
+            @partial(
+                jax.shard_map,
+                mesh=mesh,
+                in_specs=(spec_in, spec_in, spec_in, spec_in, spec_in, spec_ctx),
+                out_specs=(spec_p, P(), P()),
+                check_vma=False,
+            )
+            def _step(a, r, s_bits, h_bits, power_planes, ctx):
+                mask = _verify_core(a, r, s_bits, h_bits, ctx)
+                # Exact int64 tallies without x64: powers arrive as four
+                # uint32 planes of 16 bits each (see split_powers). Each
+                # plane sum is bounded by N*2^16, safe in uint32 for N up to
+                # 2^15 validators per shard; psum across the mesh and
+                # recombine host-side in Python ints (reference tally
+                # semantics: types/validator_set.go:662 uses int64 power).
+                valid_planes = jnp.where(mask[None], power_planes, 0)
+                talled = jnp.sum(valid_planes, axis=tuple(range(1, valid_planes.ndim)))
+                total = jnp.sum(power_planes, axis=tuple(range(1, power_planes.ndim)))
+                for ax in mesh.axis_names:
+                    talled = jax.lax.psum(talled, ax)
+                    total = jax.lax.psum(total, ax)
+                return mask, talled, total
+
+            fn = _cache[batch_rank] = jax.jit(_step)
+        return fn
 
     def step(a, r, s_bits, h_bits, power_planes):
         import numpy as np
 
-        shard_batch = tuple(
-            d // m for d, m in zip(a.shape[1:], mesh.devices.shape)
-        )
-        mask, talled, total = stepped(
+        shard_batch = _shard_batch_shape(mesh, a.shape[1:])
+        mask, talled, total = _for_rank(len(a.shape) - 1)(
             a, r, s_bits, h_bits, power_planes, make_ctx(shard_batch)
         )
 
@@ -138,7 +179,10 @@ def split_powers(powers) -> "jnp.ndarray":
 
 
 def shard_batch_arrays(mesh: Mesh, *arrays):
-    """Device-put host arrays with the trailing axes sharded over the mesh."""
-    spec = P(None, *mesh.axis_names)
-    sharding = NamedSharding(mesh, spec)
-    return tuple(jax.device_put(a, sharding) for a in arrays)
+    """Device-put host arrays with the trailing axes sharded over the mesh
+    (right-aligned; each array keeps one leading non-batch axis unsharded)."""
+    out = []
+    for a in arrays:
+        spec = P(None, *_aligned(mesh, a.ndim - 1))
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return tuple(out)

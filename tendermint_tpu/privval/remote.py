@@ -265,8 +265,20 @@ class SignerServer:
                     vote = Vote.decode(v)
                 elif f == 2:
                     chain_id = v.decode("utf-8")
+            # Reject chain-ID mismatches outright (reference:
+            # privval/signer_requestHandler.go:46): signing with a
+            # client-supplied chain ID would turn the signer into a
+            # cross-chain signing oracle, since the double-sign guard keys
+            # only on HRS + sign-bytes.
+            if chain_id is not None and chain_id != self.chain_id:
+                return _envelope(
+                    F_SIGNED_VOTE_RESP,
+                    self._err_resp(
+                        ERR_GENERIC, f"want chainID: {self.chain_id}, got chainID: {chain_id}"
+                    ),
+                )
             try:
-                signed = self.pv.sign_vote(chain_id or self.chain_id, vote)
+                signed = self.pv.sign_vote(self.chain_id, vote)
             except DoubleSignError as e:
                 return _envelope(F_SIGNED_VOTE_RESP, self._err_resp(ERR_DOUBLE_SIGN, e))
             except Exception as e:
@@ -281,8 +293,15 @@ class SignerServer:
                     prop = Proposal.decode(v)
                 elif f == 2:
                     chain_id = v.decode("utf-8")
+            if chain_id is not None and chain_id != self.chain_id:
+                return _envelope(
+                    F_SIGNED_PROPOSAL_RESP,
+                    self._err_resp(
+                        ERR_GENERIC, f"want chainID: {self.chain_id}, got chainID: {chain_id}"
+                    ),
+                )
             try:
-                signed = self.pv.sign_proposal(chain_id or self.chain_id, prop)
+                signed = self.pv.sign_proposal(self.chain_id, prop)
             except DoubleSignError as e:
                 return _envelope(F_SIGNED_PROPOSAL_RESP, self._err_resp(ERR_DOUBLE_SIGN, e))
             except Exception as e:
@@ -432,6 +451,8 @@ class SignerClient:
             if err.code == ERR_DOUBLE_SIGN:
                 raise DoubleSignError(err.description)
             raise err
+        if signed is None:
+            raise RemoteSignerError(ERR_GENERIC, "empty sign response")
         return signed
 
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
@@ -449,4 +470,6 @@ class SignerClient:
             if err.code == ERR_DOUBLE_SIGN:
                 raise DoubleSignError(err.description)
             raise err
+        if signed is None:
+            raise RemoteSignerError(ERR_GENERIC, "empty sign response")
         return signed
