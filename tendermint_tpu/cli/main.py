@@ -138,6 +138,109 @@ def run_node(home: str) -> None:
     asyncio.run(main())
 
 
+# ----------------------------------------------------------------- replay
+
+
+def run_replay(home: str, console: bool = False) -> None:
+    """Replay the WAL of the in-progress height through a fresh consensus
+    state, printing the round state after every message — interactively in
+    console mode (reference: consensus/replay_file.go:1 RunReplayFile +
+    cmd/tendermint/commands/replay.go:1).
+
+    Console commands: n/next [N] step, rs dump round state, q quit,
+    back restart from the beginning."""
+    import asyncio
+
+    from tendermint_tpu.consensus.wal import MsgInfo, TimeoutInfo
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    class _NullWAL:
+        """Replay must never mutate the WAL it reads (the reference's
+        RunReplayFile runs with a nil WAL): every consensus step would
+        otherwise append EventRoundState/EndHeight frames to the live log."""
+
+        def write(self, *_a, **_k):
+            pass
+
+        write_sync = write
+        write_end_height = write
+        flush_and_sync = write
+        close = write
+
+        def search_for_end_height(self, *_a, **_k):
+            return None
+
+    def build():
+        cfg = load_home(home)
+        with open(cfg.genesis_path()) as f:
+            gen = GenesisDoc.from_json(f.read())
+        pv = None
+        if not cfg.base.priv_validator_addr:
+            pv = FilePV.load(
+                cfg.path(cfg.base.priv_validator_key_file),
+                cfg.path(cfg.base.priv_validator_state_file),
+            )
+        node = Node(cfg, gen, priv_validator=pv)
+        cs = node.consensus
+        msgs = cs.wal.search_for_end_height(cs.rs.height - 1) or []
+        cs.wal.close()
+        cs.wal = _NullWAL()
+        return node, cs, msgs
+
+    async def replay():
+        node, cs, msgs = build()
+        cs.replay_mode = True
+        print(f"replaying {len(msgs)} WAL messages for height {cs.rs.height}")
+        print(json.dumps(cs.rs.round_state_summary()))
+        i = 0
+
+        def step_one():
+            nonlocal i
+            msg = msgs[i]
+            if isinstance(msg, MsgInfo):
+                label = type(msg.msg).__name__
+                cs._handle_msg(msg)
+            elif isinstance(msg, TimeoutInfo):
+                label = f"Timeout({msg.step})"
+                cs._handle_timeout(msg)
+            else:
+                label = type(msg).__name__
+            i += 1
+            print(f"[{i}/{len(msgs)}] {label} -> "
+                  f"H={cs.rs.height} R={cs.rs.round} S={cs.rs.step.name}")
+
+        if not console:
+            while i < len(msgs):
+                step_one()
+        else:
+            print("console: n [count] = step, rs = round state, q = quit")
+            while True:
+                try:
+                    line = input(f"replay [{i}/{len(msgs)}]> ").strip()
+                except EOFError:
+                    break
+                if line in ("q", "quit"):
+                    break
+                if line in ("rs",):
+                    print(json.dumps(cs.rs.round_state_summary(), indent=1))
+                    continue
+                if line.startswith(("n", "next")) or line == "":
+                    parts = line.split()
+                    count = int(parts[1]) if len(parts) > 1 else 1
+                    for _ in range(count):
+                        if i >= len(msgs):
+                            print("end of WAL")
+                            break
+                        step_one()
+                    continue
+                print("commands: n [count], rs, q")
+        print(json.dumps(cs.rs.round_state_summary()))
+
+    asyncio.run(replay())
+
+
 # ---------------------------------------------------------------- testnet
 
 
@@ -479,6 +582,9 @@ def main(argv=None) -> int:
     sp.add_argument("--addr", required=True, help="signer address, e.g. tcp://127.0.0.1:26659")
     sp.add_argument("--chain-id", default="harness-chain")
 
+    sub.add_parser("replay", help="replay the last height's WAL through consensus")
+    sub.add_parser("replay-console", help="interactive WAL replay (n/rs/q)")
+
     sp = sub.add_parser(
         "debug", help="capture a debug dump (node state over RPC + config + WAL) into a zip"
     )
@@ -545,6 +651,10 @@ def main(argv=None) -> int:
         run_localnet(args.output_dir, args.v, args.chain_id, args.starting_port, args.blocks)
     elif args.cmd == "signer-harness":
         run_signer_harness(args.addr, args.chain_id)
+    elif args.cmd == "replay":
+        run_replay(args.home, console=False)
+    elif args.cmd == "replay-console":
+        run_replay(args.home, console=True)
     elif args.cmd == "debug":
         debug_dump(args.home, args.rpc, args.output)
         print(json.dumps({"dump": args.output}))

@@ -28,13 +28,23 @@ class BaseConfig:
     # listens and the node connects (see privval/remote.py).
     priv_validator_addr: str = ""
     node_key_file: str = "config/node_key.json"
+    # In-process app name ("kvstore", "counter", …) OR, when proxy_app is an
+    # address, the transport to reach it: "socket" | "grpc"
+    # (reference: config/config.go ProxyApp + ABCI).
     abci: str = "kvstore"
+    # External app address, e.g. "tcp://127.0.0.1:26658". Empty = run the
+    # app named by `abci` in-process (the reference's DefaultClientCreator,
+    # proxy/client.go).
+    proxy_app: str = ""
     filter_peers: bool = False
 
 
 @dataclass
 class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
+    # gRPC broadcast API (BroadcastTx/Ping only; reference: rpc/grpc/api.go,
+    # config/config.go GRPCListenAddress). Empty = disabled.
+    grpc_laddr: str = ""
     max_open_connections: int = 900
     max_subscription_clients: int = 100
     max_subscriptions_per_client: int = 5

@@ -199,12 +199,30 @@ class ConsensusState:
     # ------------------------------------------------------------------
 
     async def _receive_loop(self) -> None:
+        defer = self.config.defer_vote_verification
+        flush_interval = max(self.config.vote_flush_interval, 0.001)
         try:
             while self._running:
                 # asyncio.Queue.get does not yield when items are ready; yield
                 # explicitly so timers, RPC, and peers are never starved.
                 await asyncio.sleep(0)
-                kind, payload = await self._queue.get()
+                if defer:
+                    # Deferred-verification mode: wait at most one flush
+                    # interval so queued unverified votes are batch-verified
+                    # even when no new input arrives.
+                    try:
+                        kind, payload = await asyncio.wait_for(
+                            self._queue.get(), timeout=flush_interval
+                        )
+                    except asyncio.TimeoutError:
+                        try:
+                            self._flush_deferred_votes()
+                        except Exception:
+                            logger.exception("CONSENSUS FAILURE!!! halting (halt-don't-corrupt)")
+                            break
+                        continue
+                else:
+                    kind, payload = await self._queue.get()
                 if kind == "quit":
                     break
                 try:
@@ -221,6 +239,11 @@ class ConsensusState:
                         self._handle_timeout(payload)
                     elif kind == "txs_available":
                         self._handle_txs_available()
+                    # Batch boundary: once the queue drains, flush deferred
+                    # votes in one device batch (storms accumulate while the
+                    # queue is busy, then verify together).
+                    if defer and self._queue.empty():
+                        self._flush_deferred_votes()
                 except Exception:
                     logger.exception("CONSENSUS FAILURE!!! halting (halt-don't-corrupt)")
                     break
@@ -381,7 +404,13 @@ class ConsensusState:
 
     def _new_step(self) -> None:
         rs = self.rs
-        self.wal.write(EventRoundState(rs.height, rs.round, int(rs.step)))
+        # Only log round-state transitions while actually running: the
+        # constructor's updateToState must not append to the WAL (the
+        # reference opens the WAL in OnStart, consensus/state.go:303, so
+        # construction never writes; this also keeps the replay CLI
+        # read-only).
+        if self._running:
+            self.wal.write(EventRoundState(rs.height, rs.round, int(rs.step)))
         self.n_steps += 1
         self._publish_rs(EVENT_NEW_ROUND_STEP)
 
@@ -828,23 +857,68 @@ class ConsensusState:
         try:
             return self._add_vote(vote, peer_id)
         except ConflictingVotesError as e:
-            if self.priv_validator_pub_key is not None and (
-                vote.validator_address == self.priv_validator_pub_key.address()
-            ):
-                logger.error("found conflicting vote from ourselves; did you unsafe_reset a validator?")
-                return False
-            if self.evpool is not None:
-                _, val = self.rs.validators.get_by_address(vote.validator_address)
-                ev = DuplicateVoteEvidence.from_votes(
-                    e.vote_a, e.vote_b, self.state.last_block_time_ns,
-                    self.rs.validators.total_voting_power(),
-                    val.voting_power if val else 0,
-                )
-                self.evpool.add_evidence_from_consensus(ev, time.time_ns(), self.rs.validators)
+            self._handle_vote_conflict(e)
             return False
         except VoteSetError as e:
             logger.debug("vote not added: %s", e)
             return False
+
+    def _handle_vote_conflict(self, e: ConflictingVotesError) -> None:
+        """Turn an equivocation into DuplicateVoteEvidence (also called by the
+        deferred-verification flush, which surfaces conflicts in batches;
+        reference: consensus/state.go:1829 tryAddVote's ErrVoteConflictingVotes
+        branch)."""
+        vote = e.vote_b
+        if self.priv_validator_pub_key is not None and (
+            vote.validator_address == self.priv_validator_pub_key.address()
+        ):
+            logger.error("found conflicting vote from ourselves; did you unsafe_reset a validator?")
+            return
+        if self.evpool is not None:
+            _, val = self.rs.validators.get_by_address(vote.validator_address)
+            ev = DuplicateVoteEvidence.from_votes(
+                e.vote_a, e.vote_b, self.state.last_block_time_ns,
+                self.rs.validators.total_voting_power(),
+                val.voting_power if val else 0,
+            )
+            self.evpool.add_evidence_from_consensus(ev, time.time_ns(), self.rs.validators)
+
+    def _flush_deferred_votes(self) -> None:
+        """Deferred-verification tick: batch-verify all queued votes in one
+        device call, surface equivocations as evidence, and re-run the 2/3
+        progress checks for every (type, round) that gained votes.
+
+        This is the consensus-side half of config.defer_vote_verification —
+        under a vote storm each flush is ONE batched kernel invocation over
+        the validator axis instead of per-vote scalar verifies (the
+        vectorized analog of the reference's per-vote path,
+        types/vote_set.go:143,203)."""
+        rs = self.rs
+        if rs.votes is not None and rs.votes.has_pending():
+            height_before = rs.height
+            votes_before = rs.votes
+            flushed = votes_before.flush_all()
+            for err in votes_before.drain_conflicts():
+                self._handle_vote_conflict(err)
+            for vtype, vround, failed in flushed:
+                if failed:
+                    logger.warning(
+                        "deferred flush: %d invalid %s signatures at round %d",
+                        len(failed), vtype.name, vround,
+                    )
+                # A progress check can COMMIT the block and advance the
+                # height, replacing rs.votes with a fresh HeightVoteSet; the
+                # remaining (type, round) pairs belong to the finished height
+                # and must not be re-checked against the new one.
+                if rs.height != height_before:
+                    break
+                self._check_progress_after_vote(vtype, vround)
+        if rs.last_commit is not None and rs.last_commit.pending_count() > 0:
+            rs.last_commit.flush()
+            for err in rs.last_commit.pop_conflicts():
+                self._handle_vote_conflict(err)
+            if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                self._enter_new_round(rs.height, 0)
 
     def _add_vote(self, vote: Vote, peer_id: str) -> bool:
         rs = self.rs
@@ -865,20 +939,33 @@ class ConsensusState:
         if vote.height != rs.height:
             return False
 
-        height = rs.height
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return False
         self.event_bus.publish_vote(vote)
+        self._check_progress_after_vote(vote.type, vote.round)
+        return True
 
-        if vote.type == SignedMsgType.PREVOTE:
-            prevotes = rs.votes.prevotes(vote.round)
+    def _check_progress_after_vote(self, vtype: SignedMsgType, vround: int) -> None:
+        """Run the 2/3-majority state transitions for one (type, round).
+
+        Factored out of _add_vote so the deferred-verification flush can
+        re-run the checks after a batch of votes commits at once
+        (reference: consensus/state.go:1880 addVote's post-add logic)."""
+        rs = self.rs
+        height = rs.height
+        # Rounds beyond the tracked window (set_round tracks round..round+1)
+        # have no vote set; nothing to check.
+        if rs.votes is None or rs.votes._get_vote_set(vround, vtype) is None:
+            return
+        if vtype == SignedMsgType.PREVOTE:
+            prevotes = rs.votes.prevotes(vround)
             block_id = prevotes.two_thirds_majority()
             if block_id is not None:
                 # Unlock on newer polka for a different block.
                 if (
                     rs.locked_block is not None
-                    and rs.locked_round < vote.round <= rs.round
+                    and rs.locked_round < vround <= rs.round
                     and rs.locked_block.hash() != block_id.hash
                 ):
                     logger.info("unlocking because of POL")
@@ -886,9 +973,9 @@ class ConsensusState:
                     rs.locked_block = None
                     rs.locked_block_parts = None
                 # Update valid block.
-                if not block_id.is_zero() and rs.valid_round < vote.round == rs.round:
+                if not block_id.is_zero() and rs.valid_round < vround == rs.round:
                     if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
-                        rs.valid_round = vote.round
+                        rs.valid_round = vround
                         rs.valid_block = rs.proposal_block
                         rs.valid_block_parts = rs.proposal_block_parts
                     else:
@@ -899,34 +986,33 @@ class ConsensusState:
                         rs.proposal_block_parts = PartSet(block_id.part_set_header)
                     self._publish_rs(EVENT_VALID_BLOCK)
 
-            if rs.round < vote.round and prevotes.has_two_thirds_any():
-                self._enter_new_round(height, vote.round)
-            elif rs.round == vote.round and rs.step >= RoundStepType.PREVOTE:
+            if rs.round < vround and prevotes.has_two_thirds_any():
+                self._enter_new_round(height, vround)
+            elif rs.round == vround and rs.step >= RoundStepType.PREVOTE:
                 block_id = prevotes.two_thirds_majority()
                 if block_id is not None and (self._is_proposal_complete() or block_id.is_zero()):
-                    self._enter_precommit(height, vote.round)
+                    self._enter_precommit(height, vround)
                 elif prevotes.has_two_thirds_any():
-                    self._enter_prevote_wait(height, vote.round)
-            elif rs.proposal is not None and 0 <= rs.proposal.pol_round == vote.round:
+                    self._enter_prevote_wait(height, vround)
+            elif rs.proposal is not None and 0 <= rs.proposal.pol_round == vround:
                 if self._is_proposal_complete():
                     self._enter_prevote(height, rs.round)
 
-        elif vote.type == SignedMsgType.PRECOMMIT:
-            precommits = rs.votes.precommits(vote.round)
+        elif vtype == SignedMsgType.PRECOMMIT:
+            precommits = rs.votes.precommits(vround)
             block_id = precommits.two_thirds_majority()
             if block_id is not None:
-                self._enter_new_round(height, vote.round)
-                self._enter_precommit(height, vote.round)
+                self._enter_new_round(height, vround)
+                self._enter_precommit(height, vround)
                 if not block_id.is_zero():
-                    self._enter_commit(height, vote.round)
+                    self._enter_commit(height, vround)
                     if self.config.skip_timeout_commit and precommits.has_all():
                         self._enter_new_round(rs.height, 0)
                 else:
-                    self._enter_precommit_wait(height, vote.round)
-            elif rs.round <= vote.round and precommits.has_two_thirds_any():
-                self._enter_new_round(height, vote.round)
-                self._enter_precommit_wait(height, vote.round)
-        return True
+                    self._enter_precommit_wait(height, vround)
+            elif rs.round <= vround and precommits.has_two_thirds_any():
+                self._enter_new_round(height, vround)
+                self._enter_precommit_wait(height, vround)
 
     def _sign_vote(self, msg_type: SignedMsgType, block_hash: bytes, psh: PartSetHeader) -> Optional[Vote]:
         rs = self.rs

@@ -68,6 +68,37 @@ class HeightVoteSet:
             return None
         return entry[0] if type_ == SignedMsgType.PREVOTE else entry[1]
 
+    def has_pending(self) -> bool:
+        """True if any round's vote set has deferred (unverified) votes."""
+        return any(
+            vs.pending_count() > 0
+            for pair in self._round_vote_sets.values()
+            for vs in pair
+        )
+
+    def flush_all(self):
+        """Flush every round vote set with deferred votes in one pass.
+
+        Returns [(type, round, failed_indices)] for each set that had
+        pending votes — the caller re-runs the 2/3 progress checks for those
+        (type, round) pairs and drains conflicts via drain_conflicts().
+        """
+        out = []
+        for round_, (prevotes, precommits) in sorted(self._round_vote_sets.items()):
+            for vs in (prevotes, precommits):
+                if vs.pending_count() > 0:
+                    failed = vs.flush()
+                    out.append((vs.signed_msg_type, round_, failed))
+        return out
+
+    def drain_conflicts(self):
+        """Collect equivocation conflicts discovered by deferred flushes."""
+        out = []
+        for prevotes, precommits in self._round_vote_sets.values():
+            out.extend(prevotes.pop_conflicts())
+            out.extend(precommits.pop_conflicts())
+        return out
+
     def prevotes(self, round_: int) -> Optional[VoteSet]:
         return self._get_vote_set(round_, SignedMsgType.PREVOTE)
 

@@ -5,9 +5,15 @@
 - "cpu": serial host loop over OpenSSL (the reference-shaped baseline — this is
   exactly what the reference does in Go, one VerifySignature per validator,
   reference: types/validator_set.go:680-702).
-- "jax": the TPU path — host computes h = SHA512(R||A||M) mod L per item
-  (cheap, C-speed hashlib), then one jitted kernel verifies the whole batch on
-  device (tendermint_tpu.ops.ed25519_jax).
+- "jax": the TPU path. Large batches take the random-linear-combination fast
+  path (ops/msm_jax.py): ONE Pippenger multiscalar check over random 128-bit
+  coefficients, ~10x less device work than per-signature ladders; if the
+  combined check fails (any bad signature present), it falls back to the
+  per-signature kernel (ops/ed25519_jax.py) to recover the exact mask — so
+  externally the semantics are always per-sig accept/reject, matching the
+  reference (types/validator_set.go:680-702). Decompressed public keys are
+  cached across calls (consensus re-verifies the same validator set every
+  height), which removes ~1/3 of the device work in steady state.
 
 Every O(validators) verification site in the framework (VerifyCommit,
 VerifyCommitLight/Trusting, vote storms, fast-sync replay, evidence) funnels
@@ -18,7 +24,7 @@ from __future__ import annotations
 
 import hashlib
 import os
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -32,6 +38,34 @@ def _bucket(n: int) -> int:
         if n <= b:
             return b
     return n
+
+
+# RLC fast-path lane buckets (A-block size Na; total lanes = 2*Na). Coarse to
+# bound the number of compiled kernel shapes; ~25% max padding waste.
+_LANE_BUCKETS = [
+    64, 256, 512, 1024, 1536, 2048, 3072, 4096, 5120, 6144, 8192,
+    10240, 12288, 16384, 20480, 24576, 32768,
+]
+
+
+def _lane_bucket(m: int) -> int:
+    for b in _LANE_BUCKETS:
+        if m <= b:
+            return b
+    return m
+
+
+# Minimum batch size for the RLC path: below this the per-signature kernel's
+# latency is fine and each extra RLC shape costs a long one-time compile.
+RLC_MIN = int(os.environ.get("TMTPU_RLC_MIN", "512"))
+
+# Below this, auto-selected "jax" routes to the host loop instead (device
+# round-trip latency dominates tiny batches).
+_JAX_MIN_BATCH = int(os.environ.get("TMTPU_JAX_MIN", "64"))
+
+
+def _rlc_enabled() -> bool:
+    return os.environ.get("TMTPU_RLC", "1") != "0"
 
 
 def backend_default() -> str:
@@ -117,13 +151,260 @@ def prepare_batch(
     )
 
 
+def _precheck_and_hash(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+):
+    """Shared host prep: length/canonical-s checks + h = SHA512(R||A||M) mod L.
+
+    Returns (precheck bool[n], a_rows (n,32) u8, r_rows (n,32) u8,
+    s_ints list[int], h_ints list[int]); rows failing precheck have zeroed
+    entries."""
+    n = len(pubkeys)
+    precheck = np.zeros(n, dtype=bool)
+    a_rows = np.zeros((n, 32), dtype=np.uint8)
+    r_rows = np.zeros((n, 32), dtype=np.uint8)
+    s_ints = [0] * n
+    h_ints = [0] * n
+    sha512 = hashlib.sha512
+    for i in range(n):
+        pk, msg, sig = bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i])
+        if len(pk) != 32 or len(sig) != 64:
+            continue
+        s_int = int.from_bytes(sig[32:], "little")
+        if s_int >= L:
+            continue  # non-canonical s: reject without device work
+        precheck[i] = True
+        a_rows[i] = np.frombuffer(pk, dtype=np.uint8)
+        r_rows[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+        s_ints[i] = s_int
+        h_ints[i] = int.from_bytes(sha512(sig[:32] + pk + msg).digest(), "little") % L
+    return precheck, a_rows, r_rows, s_ints, h_ints
+
+
+# ---------------------------------------------------------------------------
+# Decompressed-pubkey cache for the RLC path. Consensus verifies the same
+# validator keys every height; decompression (a ~250-mul sqrt chain per
+# point) is the single largest per-lane cost in the MSM kernel, so cache the
+# extended coordinates keyed by the 32-byte encoding.
+
+_A_CACHE: dict = {}  # pubkey bytes -> (x, y, z, t) each (20,) int32, or None if invalid
+_A_CACHE_MAX = 65536
+
+
+def _fill_a_cache(rows: "np.ndarray") -> None:
+    """Decompress unique pubkey rows on device and populate the cache."""
+    from tendermint_tpu.ops.msm_jax import decompress_rows
+
+    uniq = {bytes(r.tobytes()) for r in rows}
+    missing = [k for k in uniq if k not in _A_CACHE]
+    if not missing:
+        return
+    missing = missing[:_A_CACHE_MAX]  # never cache beyond capacity
+    coords, ok = decompress_rows(
+        np.stack([np.frombuffer(k, dtype=np.uint8) for k in missing])
+    )
+    while _A_CACHE and len(_A_CACHE) + len(missing) > _A_CACHE_MAX:
+        _A_CACHE.pop(next(iter(_A_CACHE)))
+    for j, k in enumerate(missing):
+        if ok[j]:
+            _A_CACHE[k] = tuple(np.ascontiguousarray(coords[c][:, j]) for c in range(4))
+        else:
+            _A_CACHE[k] = None
+
+
+class _RlcCall:
+    """An in-flight RLC batch check: device work submitted, not yet synced.
+
+    Splitting submit from finish lets callers pipeline batches — JAX's async
+    dispatch overlaps the next batch's host prep (hashing, sorting, scalar
+    math) with the previous batch's device execution."""
+
+    __slots__ = ("precheck", "n", "na", "cached", "dev", "a_rows", "prep_seconds")
+
+    def __init__(self, precheck, n, na, cached, dev, a_rows, prep_seconds):
+        self.precheck = precheck
+        self.n = n
+        self.na = na
+        self.cached = cached
+        self.dev = dev
+        self.a_rows = a_rows
+        self.prep_seconds = prep_seconds
+
+
+# Timing of the last completed RLC call (host-prep vs total), for bench.py.
+LAST_RLC_TIMINGS: dict = {}
+
+
+def _rlc_submit(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> _RlcCall:
+    """Host prep + device submit of the RLC combined check (no sync)."""
+    import time as _time
+
+    from tendermint_tpu.crypto.ed25519_ref import BASE, point_compress
+    from tendermint_tpu.ops import msm_jax
+
+    t0 = _time.perf_counter()
+    n = len(pubkeys)
+    precheck, a_rows, r_rows, s_ints, h_ints = _precheck_and_hash(pubkeys, msgs, sigs)
+
+    # Exclude rows whose pubkey is a cached-invalid encoding: their verdict
+    # is False regardless, and excluding them keeps the batch equation clean.
+    keys = [bytes(pubkeys[i]) for i in range(n)]
+    for i in range(n):
+        if precheck[i] and _A_CACHE.get(keys[i], True) is None:
+            precheck[i] = False
+
+    # Random 128-bit coefficients, forced odd (z=0 would silently exclude a
+    # signature from the check). OS-entropy seeded per call.
+    rng = np.random.default_rng()
+    zw = rng.integers(0, 1 << 64, size=(n, 2), dtype=np.uint64)
+    zs = [((int(zw[i, 0]) << 64) | int(zw[i, 1]) | 1) if precheck[i] else 0 for i in range(n)]
+
+    w_scalars = [zs[i] * h_ints[i] % L if precheck[i] else 0 for i in range(n)]
+    u = sum(zs[i] * s_ints[i] for i in range(n) if precheck[i]) % L
+
+    b_enc = np.frombuffer(point_compress(BASE), dtype=np.uint8)
+    na = _lane_bucket(n + 1)
+
+    # A block: [A_0..A_{n-1}, B, pads]; excluded/pad lanes are the basepoint
+    # encoding with scalar 0 (bucket 0 is never summed).
+    pts_r = np.tile(b_enc, (na, 1))
+    if precheck.any():
+        pts_r[:n][precheck] = r_rows[precheck]
+
+    scalars = [0] * (2 * na)
+    scalars[:n] = w_scalars
+    scalars[n] = (L - u) % L
+    scalars[na : na + n] = [zs[i] if precheck[i] else 0 for i in range(n)]
+
+    included = [keys[i] for i in range(n) if precheck[i]]
+    cached = bool(included) and all(k in _A_CACHE for k in included)
+    if cached:
+        bx, by, bz, bt = msm_jax.basepoint_coords()
+        ax = np.empty((20, na), dtype=np.int32)
+        ay = np.empty((20, na), dtype=np.int32)
+        az = np.empty((20, na), dtype=np.int32)
+        at = np.empty((20, na), dtype=np.int32)
+        ax[:] = bx[:, None]
+        ay[:] = by[:, None]
+        az[:] = bz[:, None]
+        at[:] = bt[:, None]
+        for i in range(n):
+            if precheck[i]:
+                cx, cy, cz, ct = _A_CACHE[keys[i]]
+                ax[:, i], ay[:, i], az[:, i], at[:, i] = cx, cy, cz, ct
+        dev = msm_jax.rlc_check_cached_submit((ax, ay, az, at), pts_r, scalars)
+    else:
+        pts_a = np.tile(b_enc, (na, 1))
+        if precheck.any():
+            pts_a[:n][precheck] = a_rows[precheck]
+        dev = msm_jax.rlc_check_submit(np.concatenate([pts_a, pts_r], axis=0), scalars)
+    return _RlcCall(
+        precheck, n, na, cached, dev, a_rows if not cached else None,
+        _time.perf_counter() - t0,
+    )
+
+
+def _rlc_finish(call: _RlcCall) -> Optional[np.ndarray]:
+    """Sync the device result; mask on success, None -> per-sig fallback."""
+    batch_ok_dev, ok_dev = call.dev
+    batch_ok = bool(np.asarray(batch_ok_dev))
+    ok = np.asarray(ok_dev)
+    precheck, n, na = call.precheck, call.n, call.na
+    if call.cached:
+        lanes_ok = bool(ok[:n][precheck].all()) if precheck.any() else True
+    else:
+        lanes_ok = (
+            bool(ok[:n][precheck].all() and ok[na : na + n][precheck].all())
+            if precheck.any()
+            else True
+        )
+        # Populate the pubkey cache for subsequent calls (steady-state
+        # consensus hits the cached kernel, skipping A decompression).
+        if precheck.any():
+            _fill_a_cache(call.a_rows[precheck])
+    if batch_ok and lanes_ok:
+        return precheck
+    return None
+
+
+def _verify_batch_rlc(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> Optional[np.ndarray]:
+    """RLC fast path. Returns the bool mask if the combined check passes,
+    or None when the caller must fall back to the per-signature kernel
+    (some signature failed, or an encoding was invalid)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    call = _rlc_submit(pubkeys, msgs, sigs)
+    mask = _rlc_finish(call)
+    LAST_RLC_TIMINGS.update(
+        prep_ms=call.prep_seconds * 1e3,
+        total_ms=(_time.perf_counter() - t0) * 1e3,
+        cached=call.cached,
+    )
+    return mask
+
+
+# Which path the last verify_batch_jax call took: "rlc", "persig", "sharded"
+# (observability + tests).
+LAST_JAX_PATH: list = [""]
+
+_SHARDED_RUNNER = None  # cached (n_devices, run_fn)
+
+
+def _sharded_runner():
+    """Production multi-chip path: when >1 jax device is visible, shard the
+    per-signature kernel's batch axis across a 1D mesh (parallel/sharded.py).
+    Uses the largest power-of-two device count so power-of-two shape buckets
+    always divide evenly. Returns None on single-device hosts."""
+    global _SHARDED_RUNNER
+    knob = os.environ.get("TMTPU_SHARDED", "auto")
+    if knob == "0":
+        return None
+    import jax
+
+    devs = jax.devices()
+    if knob != "1" and devs and devs[0].platform == "cpu":
+        # "auto" engages only on accelerator platforms: the CPU test env
+        # exposes 8 virtual devices for mesh tests, but routing every
+        # verify_batch through shard_map there would just burn compiles.
+        return None
+    nd = 1 << (len(devs).bit_length() - 1)  # largest pow2 <= len(devs)
+    if nd < 2:
+        return None
+    if _SHARDED_RUNNER is not None and _SHARDED_RUNNER[0] == nd:
+        return _SHARDED_RUNNER[1]
+    from tendermint_tpu.parallel.sharded import make_mesh, sharded_verify
+
+    mesh = make_mesh(devs[:nd], axis_names=("vals",))
+    run = sharded_verify(mesh)
+    _SHARDED_RUNNER = (nd, run)
+    return run
+
+
 def verify_batch_jax(
     pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> np.ndarray:
     from tendermint_tpu.ops.ed25519_jax import verify_prepared
 
+    sharded = _sharded_runner()
+    if sharded is None and _rlc_enabled() and len(pubkeys) >= RLC_MIN:
+        mask = _verify_batch_rlc(pubkeys, msgs, sigs)
+        if mask is not None:
+            LAST_JAX_PATH[0] = "rlc"
+            return mask
+        # Combined check failed: at least one signature is bad (or an
+        # encoding was invalid) — recover the exact per-signature mask.
     a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
-    mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
+    if sharded is not None:
+        LAST_JAX_PATH[0] = "sharded"
+        mask = np.asarray(sharded(a, r, s_bits, h_bits))[:n]
+    else:
+        LAST_JAX_PATH[0] = "persig"
+        mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
     return mask & precheck
 
 
@@ -162,6 +443,13 @@ def verify_batch(
             out[i] = sr25519_verify(bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i]))
         return out
     be = backend or backend_default()
+    # Auto-selected jax falls back to the host loop for tiny batches: a
+    # handful of signatures is faster on CPU than one device round-trip
+    # (100-200ms through a TPU tunnel), and a 1-2 validator chain should
+    # never block on a kernel compile. An EXPLICIT backend="jax" is honored
+    # regardless (tests, benches).
+    if backend is None and be == "jax" and len(pubkeys) < _JAX_MIN_BATCH:
+        be = "cpu"
     if be == "cpu":
         return verify_batch_cpu(pubkeys, msgs, sigs)
     if be == "jax":

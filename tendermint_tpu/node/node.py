@@ -94,10 +94,18 @@ class Node:
             genesis.validate_and_complete()
             state = state_from_genesis(genesis)
 
-        # ABCI app (4 logical connections)
+        # ABCI app (4 logical connections); an external proxy_app address
+        # selects the socket/grpc transport (reference: proxy/client.go)
         if client_creator is None:
-            app = app or default_app(config.base.abci)
-            client_creator = local_client_creator(app)
+            if config.base.proxy_app:
+                from tendermint_tpu.proxy.multi import default_client_creator
+
+                client_creator = default_client_creator(
+                    config.base.proxy_app, config.base.abci
+                )
+            else:
+                app = app or default_app(config.base.abci)
+                client_creator = local_client_creator(app)
         self.app = app
         self.proxy_app = AppConns(client_creator)
 
@@ -164,6 +172,7 @@ class Node:
         )
 
         self.rpc_server = None
+        self.grpc_server = None
         self._running = False
 
         # p2p (reference: node/node.go:754-793 createTransport/createSwitch)
@@ -280,6 +289,11 @@ class Node:
 
             self.rpc_server = RPCServer(self)
             await self.rpc_server.start()
+        if self.config.rpc.grpc_laddr:
+            from tendermint_tpu.rpc.grpc_api import GrpcBroadcastServer
+
+            self.grpc_server = GrpcBroadcastServer(self, self.config.rpc.grpc_laddr)
+            self.grpc_server.start()
         if self.state_sync:
             self._statesync_task = asyncio.create_task(
                 self._run_state_sync(), name="statesync"
@@ -341,6 +355,8 @@ class Node:
             self._statesync_task.cancel()
         if self.rpc_server is not None:
             await self.rpc_server.stop()
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
         if self.switch is not None:
             await self.switch.stop()
         await self.consensus.stop()

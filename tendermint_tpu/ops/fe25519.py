@@ -170,7 +170,25 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 @jax.jit
 def square(a: jnp.ndarray) -> jnp.ndarray:
-    return mul(a, a)
+    """Field square via the symmetric convolution: prod[k] = Σ_{i+j=k} a_i a_j
+    = (a_{k/2})² [k even] + 2·Σ_{i<j, i+j=k} a_i a_j — about half the
+    multiply-accumulates of the general product (int32 multiplies are emulated
+    on the TPU VPU, so MAC count is the dominant cost). The partial sums are
+    term-for-term identical to mul(a, a)'s, so the same int32 bound applies."""
+    acc = jnp.zeros((2 * NLIMBS - 1, *a.shape[1:]), dtype=jnp.int32)
+    a2 = a + a  # ≤ 2^14+: products vs carried limbs stay within the conv bound
+    for i in range(NLIMBS):
+        acc = acc.at[2 * i].add(a[i] * a[i])
+        if i + 1 < NLIMBS:
+            acc = acc.at[2 * i + 1 : i + NLIMBS].add(a[i] * a2[i + 1 :])
+    for _ in range(2):
+        c = acc >> RADIX
+        acc = (acc & jnp.int32(MASK)) + jnp.concatenate(
+            [jnp.zeros_like(c[:1]), c[:-1]], axis=0
+        )
+        acc = acc.at[NLIMBS - 1].add(jnp.int32(WRAP) * c[2 * NLIMBS - 2])
+    out = acc[:NLIMBS].at[: NLIMBS - 1].add(jnp.int32(WRAP) * acc[NLIMBS:])
+    return carry(out)
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:

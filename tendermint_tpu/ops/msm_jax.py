@@ -1,0 +1,516 @@
+"""Random-linear-combination (RLC) batch Ed25519 verification on TPU.
+
+The fast path for large batches: instead of N independent double-scalar
+ladders (ops/ed25519_jax.py, ~3.5k field muls per signature), check ONE
+group equation over random 128-bit coefficients z_i:
+
+    [sum z_i s_i mod L] B  ==  sum [z_i] R_i  +  sum [z_i h_i mod L] A_i
+
+rearranged as  sum [w_i] A_i + [(L-u) mod L] B + sum [z_i] R_i == identity,
+with w_i = z_i h_i mod L and u = sum z_i s_i mod L. If every per-signature
+equation holds the combination is the identity; if any fails, the
+combination is the identity with probability <= ~2^-125 over the z_i. The
+caller falls back to the per-signature kernel when the batch check fails,
+so externally-visible semantics stay per-sig accept/reject — RLC is an
+accelerator, not a replacement (reference semantics:
+types/validator_set.go:680-702 verifies each signature individually).
+
+The multiscalar multiplication is Pippenger reshaped for a vector machine
+(no scatter, no data-dependent control flow on device):
+
+  host   per 8-bit window: stable-sort lane indices by digit; compute
+         per-bucket boundary positions; decompose each boundary prefix
+         into its Fenwick (binary-representation) tree nodes.
+  device 1. decompress points (invalid -> identity, flagged);
+         2. gather lanes into sorted order per window;
+         3. pair-tree up-sweep: node (l, k) = sum of sorted lanes
+            [k*2^l, (k+1)*2^l)  — log2(N) unrolled vector adds, total
+            work ~N lane-adds per window;
+         4. gather <=16 tree nodes per bucket boundary and add them:
+            prefix[v] = exact sum of all lanes with digit <= v;
+         5. bucket_v = prefix[v] - prefix[v-1]; weighted bucket reduce
+            via suffix sums (sum_v v*S_v = sum_j suffix_j);
+         6. Horner combine across windows (8 doublings + 1 add each, on
+            a single point).
+
+Per signature this costs ~80 batched point additions + 2 point
+decompressions, vs ~770 add-equivalents for the per-sig ladder — the
+doubling chains (the per-lane ladder's fixed cost) are shared across the
+whole batch, which is the entire idea of Pippenger.
+
+Window size is fixed at 8 bits so digits are exactly the scalar bytes.
+
+A-point caching: consensus verifies the SAME validator public keys every
+height, so decompression of A (a ~250-mul sqrt chain per point) is cached
+across calls keyed by pubkey bytes — see crypto/batch.py. The kernel
+variant `_rlc_core_cached` accepts predecompressed A coordinates.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.ops import fe25519 as fe
+from tendermint_tpu.ops.ed25519_jax import (
+    FieldCtx,
+    Point,
+    decompress,
+    identity,
+    make_ctx,
+)
+
+WINDOW_BITS = 8
+NWIN = 32  # 256 bits / 8
+NBUCKETS = 1 << WINDOW_BITS
+FENWICK_K = 16  # max tree levels for N < 2^16 lanes
+
+
+# --------------------------------------------------------------------------
+# Small-constant context: rank-agnostic (20,) buffers reshaped per use.
+# The MSM kernel works at many intermediate shapes (per tree level, per
+# bucket phase), so full-batch materialized constants (FieldCtx) are only
+# used for the single decompress shape; everything else uses these.
+
+
+class SmallCtx(NamedTuple):
+    comp: jnp.ndarray  # (20,)
+    corr: jnp.ndarray  # (20,)
+    one: jnp.ndarray  # (20,)
+    d2: jnp.ndarray  # (20,)
+
+
+def make_small_ctx() -> SmallCtx:
+    return SmallCtx(
+        comp=jnp.asarray(np.asarray(fe.COMP)),
+        corr=jnp.asarray(np.asarray(fe.CORR)),
+        one=jnp.asarray(fe.from_int(1)),
+        d2=jnp.asarray(fe.from_int(fe.D2)),
+    )
+
+
+def _rs(c: jnp.ndarray, ndim: int) -> jnp.ndarray:
+    """Reshape a (20,) constant buffer for broadcasting against rank-ndim."""
+    return c.reshape((fe.NLIMBS,) + (1,) * (ndim - 1))
+
+
+def _sub(C: SmallCtx, a, b):
+    return fe.sub(a, b, _rs(C.comp, a.ndim), _rs(C.corr, a.ndim))
+
+
+def _neg(C: SmallCtx, a):
+    return _sub(C, jnp.zeros_like(a), a)
+
+
+def _padd(C: SmallCtx, p: Point, q: Point) -> Point:
+    """Unified a=-1 extended add (same formula as ed25519_jax.point_add but
+    with rank-agnostic constants)."""
+    a = fe.mul(_sub(C, p.y, p.x), _sub(C, q.y, q.x))
+    b = fe.mul(fe.add(p.y, p.x), fe.add(q.y, q.x))
+    c = fe.mul(fe.mul(p.t, q.t), _rs(C.d2, p.t.ndim))
+    d = fe.mul_small(fe.mul(p.z, q.z), 2)
+    e = _sub(C, b, a)
+    f = _sub(C, d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def _pdbl(C: SmallCtx, p: Point) -> Point:
+    xx = fe.square(p.x)
+    yy = fe.square(p.y)
+    zz2 = fe.mul_small(fe.square(p.z), 2)
+    xy2 = fe.square(fe.add(p.x, p.y))
+    e = _sub(C, xy2, fe.add(xx, yy))
+    g = _sub(C, yy, xx)
+    f = _sub(C, g, zz2)
+    h = _neg(C, fe.add(xx, yy))
+    return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def _pneg(C: SmallCtx, p: Point) -> Point:
+    return Point(_neg(C, p.x), p.y, p.z, _neg(C, p.t))
+
+
+def _pidentity(C: SmallCtx, batch_shape) -> Point:
+    z = jnp.zeros((fe.NLIMBS, *batch_shape), dtype=jnp.int32)
+    one = jnp.broadcast_to(_rs(C.one, 1 + len(batch_shape)), z.shape)
+    return Point(z, one, one, z)
+
+
+def _pselect(cond, a: Point, b: Point) -> Point:
+    return Point(
+        fe.select(cond, a.x, b.x),
+        fe.select(cond, a.y, b.y),
+        fe.select(cond, a.z, b.z),
+        fe.select(cond, a.t, b.t),
+    )
+
+
+# --------------------------------------------------------------------------
+# Level geometry (shared host/device so Fenwick indices line up).
+
+
+def level_widths(n_lanes: int) -> list:
+    """Widths of the pair-tree levels: level 0 = n_lanes, each next level
+    halves (odd widths padded up by one identity lane first)."""
+    widths = [n_lanes]
+    w = n_lanes
+    while w > 1:
+        w = (w + 1) // 2
+        widths.append(w)
+    return widths
+
+
+def level_offsets(n_lanes: int) -> Tuple[list, int]:
+    widths = level_widths(n_lanes)
+    offs = []
+    total = 0
+    for w in widths:
+        offs.append(total)
+        total += w
+    return offs, total
+
+
+# --------------------------------------------------------------------------
+# Host-side preparation.
+
+
+def fenwick_node_indices(ends: np.ndarray, n_lanes: int) -> np.ndarray:
+    """ends: (T, NBUCKETS) int32, ends[w, v] = number of lanes whose window-w
+    digit is <= v. Returns (T, NBUCKETS, FENWICK_K) int32 of global indices
+    into the concatenated tree-levels array; slot l holds the level-l node of
+    the Fenwick decomposition of prefix [0, ends[w, v]) — or the identity
+    lane (index = total width) when bit l of the boundary is clear.
+
+    Derivation: writing e = sum over set bits 2^l, the prefix [0, e)
+    decomposes into one aligned block per set bit: the level-l block starting
+    at offset (e >> (l+1)) << (l+1), i.e. node index (e >> (l+1)) << 1."""
+    offs, total = level_offsets(n_lanes)
+    e = ends.astype(np.int64)
+    out = np.full((*ends.shape, FENWICK_K), total, dtype=np.int32)  # identity pad
+    for lvl in range(min(FENWICK_K, len(offs))):
+        bit = (e >> lvl) & 1
+        idx = offs[lvl] + ((e >> (lvl + 1)) << 1)
+        out[..., lvl] = np.where(bit == 1, idx, total).astype(np.int32)
+    return out
+
+
+def sort_windows(digits: np.ndarray):
+    """digits: (n_lanes, NWIN) uint8 — window w digit of lane i is byte w of
+    its scalar. Returns (perm (T, N) int32, node_idx (T, NBUCKETS, K) int32).
+    """
+    n = digits.shape[0]
+    perm = np.empty((NWIN, n), dtype=np.int32)
+    ends = np.empty((NWIN, NBUCKETS), dtype=np.int64)
+    for w in range(NWIN):
+        col = digits[:, w]
+        perm[w] = np.argsort(col, kind="stable").astype(np.int32)
+        counts = np.bincount(col, minlength=NBUCKETS)
+        ends[w] = np.cumsum(counts)
+    node_idx = fenwick_node_indices(ends, n)
+    return perm, node_idx
+
+
+def scalars_to_bytes(scalars: Sequence[int], n_lanes: int) -> np.ndarray:
+    """Little-endian (n_lanes, 32) uint8; rows past len(scalars) are zero."""
+    out = np.zeros((n_lanes, 32), dtype=np.uint8)
+    for i, s in enumerate(scalars):
+        out[i] = np.frombuffer(int(s).to_bytes(32, "little"), dtype=np.uint8)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Device kernel.
+
+
+_TREE_SCAN_WIDTH = 256  # levels at or below this width run in one scan body
+
+
+def _pad_lanes(C: SmallCtx, p: Point, to: int) -> Point:
+    w = p.x.shape[-1]
+    if w == to:
+        return p
+    pad = _pidentity(C, p.x.shape[1:-1] + (to - w,))
+    return Point(*(jnp.concatenate([a, b], axis=-1) for a, b in zip(p, pad)))
+
+
+def _halve(C: SmallCtx, p: Point) -> Point:
+    """One tree level: pairwise add over the (even-width) last axis."""
+    return _padd(
+        C,
+        Point(*(a[..., 0::2] for a in p)),
+        Point(*(a[..., 1::2] for a in p)),
+    )
+
+
+def _tree_levels(C: SmallCtx, p: Point) -> Point:
+    """Build the concatenated pair-tree over the last axis, appending one
+    identity lane at the end (the Fenwick pad target). p: (20, T, N).
+
+    Compile-time shaping: wide levels (width > 256) are unrolled (the work
+    shrinks geometrically, so unrolling is also the work-efficient layout);
+    the tail levels run as ONE lax.scan body over fixed (…, 256)-padded
+    arrays, so the whole tail costs a single point-add in the compiled
+    graph. Level geometry must match level_widths()/level_offsets()."""
+    widths = level_widths(p.x.shape[-1])
+    levels = [p]
+    cur = p
+    while cur.x.shape[-1] > _TREE_SCAN_WIDTH:
+        w = cur.x.shape[-1]
+        if w % 2 == 1:
+            cur = _pad_lanes(C, cur, w + 1)
+        cur = _halve(C, cur)
+        levels.append(cur)
+
+    n_tail = len(widths) - len(levels)
+    if n_tail > 0:
+        # Fixed-width tail: state is the current level padded to 256; each
+        # iteration halves (pad odd→even first via the identity padding
+        # already present) and re-pads to 256. ys collects every produced
+        # level; logical widths come from level_widths().
+        w0 = 1 << (max(cur.x.shape[-1] - 1, 1)).bit_length()  # pow2 >= width
+        w0 = max(w0, 2)
+        state = tuple(_pad_lanes(C, cur, w0))
+
+        def body(st, _):
+            pt = Point(*st)
+            nxt = _pad_lanes(C, _halve(C, pt), w0)
+            return tuple(nxt), tuple(nxt)
+
+        _, ys = jax.lax.scan(body, state, None, length=n_tail)
+        # ys coords: (n_tail, 20, …, w0); level i (0-based in tail) has
+        # logical width widths[base + i].
+        base = len(levels)
+        for i in range(n_tail):
+            lw = widths[base + i]
+            levels.append(Point(*(ys[c][i][..., :lw] for c in range(4))))
+
+    pad = _pidentity(C, p.x.shape[1:-1] + (1,))
+    return Point(
+        *(
+            jnp.concatenate(
+                [lv[i][..., : widths[k]] for k, lv in enumerate(levels)] + [pad[i]],
+                axis=-1,
+            )
+            for i in range(4)
+        )
+    )
+
+
+def _gather_lanes(p: Point, perm: jnp.ndarray) -> Point:
+    """p coords (20, N); perm (T, N) -> coords (20, T, N)."""
+    return Point(*(c[:, perm] for c in p))
+
+
+def _gather_nodes(tree: Point, node_idx: jnp.ndarray) -> Point:
+    """tree coords (20, T, Wtot+1); node_idx (T, NBUCKETS*K) ->
+    (20, T, NBUCKETS, K)."""
+    t_, flat = node_idx.shape[0], node_idx.shape[1] * node_idx.shape[2]
+    idx = node_idx.reshape(1, t_, flat)
+    out = []
+    for c in tree:
+        g = jnp.take_along_axis(c, idx, axis=-1)
+        out.append(g.reshape(c.shape[0], t_, node_idx.shape[1], node_idx.shape[2]))
+    return Point(*out)
+
+
+def _reduce_last_axis(C: SmallCtx, p: Point) -> Point:
+    """Pair-tree sum over the last axis (power-of-two width)."""
+    while p.x.shape[-1] > 1:
+        p = _padd(
+            C,
+            Point(*(a[..., 0::2] for a in p)),
+            Point(*(a[..., 1::2] for a in p)),
+        )
+    return Point(*(a[..., 0] for a in p))
+
+
+def _sum_last_axis_scan(C: SmallCtx, p: Point) -> Point:
+    """Tree-sum over the last axis (any width) as ONE scan body: state stays
+    at a fixed power-of-two width, each iteration halves and re-pads with
+    identity. Work is W·log W lane-adds instead of W, but W here is the
+    256-bucket axis — compile size matters more than the small extra work."""
+    w = p.x.shape[-1]
+    if w == 1:
+        return Point(*(a[..., 0] for a in p))
+    w0 = max(1 << (w - 1).bit_length(), 2)
+    state = tuple(_pad_lanes(C, p, w0))
+
+    def body(st, _):
+        nxt = tuple(_pad_lanes(C, _halve(C, Point(*st)), w0))
+        return nxt, None
+
+    steps = (w0 - 1).bit_length()
+    st, _ = jax.lax.scan(body, state, None, length=steps)
+    return Point(*(a[..., 0] for a in st))
+
+
+def _weighted_bucket_sum(C: SmallCtx, prefix: Point) -> Point:
+    """prefix: (20, T, NBUCKETS) — prefix[v] = exact sum of all sorted lanes
+    with digit <= v. Returns per-window W = sum_{v>=1} v * bucket_v, (20, T).
+
+    The bucket differences telescope: with bucket_v = P_v - P_{v-1},
+        sum_{v=1}^{V} v (P_v - P_{v-1})  =  V*P_V  -  sum_{v=0}^{V-1} P_v
+    (V = 255). No per-bucket subtraction or suffix scan is needed, and the
+    bucket-0 contribution (zero-scalar / padding lanes) appears in every
+    P_v, so it cancels exactly: V*P_V carries V copies, the sum carries V."""
+    v_max = prefix.x.shape[-1] - 1  # 255
+    p_last = Point(*(a[..., -1] for a in prefix))  # (20, T)
+    rest = Point(*(a[..., :-1] for a in prefix))  # v = 0..254
+    s = _sum_last_axis_scan(C, rest)
+
+    # [255] P_255 = [256] P_255 - P_255: 8 doublings + one add of the negation.
+    def dbl_body(st, _):
+        return tuple(_pdbl(C, Point(*st))), None
+
+    st, _ = jax.lax.scan(dbl_body, tuple(p_last), None, length=v_max.bit_length())
+    m = _padd(C, Point(*st), _pneg(C, p_last))  # [256]P - P = [255]P
+    return _padd(C, m, _pneg(C, s))
+
+
+def _combine_windows(C: SmallCtx, w_pts: Point) -> Point:
+    """w_pts coords (20, T) with window w weight 256^w. Horner from MSB."""
+    t_ = w_pts.x.shape[-1]
+    acc = Point(*(a[..., t_ - 1] for a in w_pts))  # (20,)
+    xs = jnp.stack(
+        [jnp.moveaxis(a[..., : t_ - 1], -1, 0) for a in w_pts], axis=1
+    )  # (T-1, 4, 20)
+    xs = xs[::-1]  # MSB-first over remaining windows
+
+    def body(acc_coords, wp):
+        def dbl(_, st):
+            return tuple(_pdbl(C, Point(*st)))
+
+        acc_coords = jax.lax.fori_loop(0, WINDOW_BITS, dbl, acc_coords)
+        acc = _padd(C, Point(*acc_coords), Point(wp[0], wp[1], wp[2], wp[3]))
+        return tuple(acc), None
+
+    acc_coords, _ = jax.lax.scan(body, tuple(acc), xs)
+    return Point(*acc_coords)
+
+
+def _msm_is_identity(C: SmallCtx, pts: Point, perm, node_idx) -> jnp.ndarray:
+    """pts: decompressed valid points (20, N); perm (T, N);
+    node_idx (T, NBUCKETS, K). Returns scalar bool: MSM == identity."""
+    gathered = _gather_lanes(pts, perm)  # (20, T, N)
+    tree = _tree_levels(C, gathered)  # (20, T, Wtot+1)
+    nodes = _gather_nodes(tree, node_idx)  # (20, T, 256, K)
+    prefix = _reduce_last_axis(C, nodes)  # (20, T, 256)
+    w_pts = _weighted_bucket_sum(C, prefix)  # (20, T)
+    total = _combine_windows(C, w_pts)  # (20,)
+    return fe.is_zero(total.x) & fe.eq(total.y, total.z)
+
+
+def _rlc_core(
+    pts_bytes: jnp.ndarray,  # (32, N) uint8 — A lanes, B lane, R lanes, pads
+    perm: jnp.ndarray,  # (T, N) int32
+    node_idx: jnp.ndarray,  # (T, NBUCKETS, K) int32
+    fctx: FieldCtx,  # materialized at batch shape (N,) for decompress
+    C: SmallCtx,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (batch_ok scalar bool, lane_ok bool (N,))."""
+    p, ok = decompress(fctx, pts_bytes)
+    p = _pselect(ok, p, identity(fctx))
+    return _msm_is_identity(C, p, perm, node_idx), ok
+
+
+def _rlc_core_cached(
+    ax, ay, az, at,  # (20, Na) predecompressed A block (incl. B lane)
+    r_bytes,  # (32, Nr) uint8
+    perm,
+    node_idx,
+    fctx: FieldCtx,  # at shape (Nr,)
+    C: SmallCtx,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Cached-A variant: lanes = [A block | R block]; only R is decompressed.
+    Returns (batch_ok, r_ok (Nr,))."""
+    r, r_ok = decompress(fctx, r_bytes)
+    r = _pselect(r_ok, r, identity(fctx))
+    pts = Point(
+        *(
+            jnp.concatenate([a, b], axis=-1)
+            for a, b in zip(Point(ax, ay, az, at), r)
+        )
+    )
+    return _msm_is_identity(C, pts, perm, node_idx), r_ok
+
+
+_rlc_jit = jax.jit(_rlc_core)
+_rlc_cached_jit = jax.jit(_rlc_core_cached)
+
+
+def basepoint_coords() -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Host constants: the ed25519 basepoint in extended limbs (20,) int32."""
+    from tendermint_tpu.crypto.ed25519_ref import BASE
+
+    x, y, z, t = BASE
+    return (fe.from_int(x), fe.from_int(y), fe.from_int(z), fe.from_int(t))
+
+
+_decompress_jit = jax.jit(lambda b, fctx: decompress(fctx, b))
+
+
+def decompress_rows(rows: np.ndarray) -> Tuple[Tuple[np.ndarray, ...], np.ndarray]:
+    """rows (m, 32) uint8 -> ((x, y, z, t) each (20, m) int32, ok (m,) bool).
+    Pads to a small shape-bucket internally; used to fill the pubkey cache."""
+    m = rows.shape[0]
+    pad = 1 << max(6, (m - 1).bit_length())
+    buf = np.zeros((pad, 32), dtype=np.uint8)
+    buf[:, 1] = 0x80  # y=2^255-ish: invalid, but masked by slicing below
+    buf[:m] = rows
+    p, ok = _decompress_jit(np.ascontiguousarray(buf.T), make_ctx((pad,)))
+    coords = tuple(np.asarray(c)[:, :m] for c in p)
+    return coords, np.asarray(ok)[:m]
+
+
+def rlc_check_submit(pts_bytes: np.ndarray, scalars: Sequence[int]):
+    """Host prep + async device submit: pts_bytes (N, 32) uint8 encodings,
+    scalars N ints < L (0 = excluded lane). Returns unsynced device values
+    (batch_ok, lane_ok[N]) — np.asarray() them to sync."""
+    n = pts_bytes.shape[0]
+    digits = scalars_to_bytes(scalars, n)
+    perm, node_idx = sort_windows(digits)
+    fctx = make_ctx((n,))
+    return _rlc_jit(
+        np.ascontiguousarray(pts_bytes.T), perm, node_idx, fctx, make_small_ctx()
+    )
+
+
+def rlc_check(pts_bytes: np.ndarray, scalars: Sequence[int]) -> Tuple[bool, np.ndarray]:
+    batch_ok, ok = rlc_check_submit(pts_bytes, scalars)
+    return bool(np.asarray(batch_ok)), np.asarray(ok)
+
+
+def rlc_check_cached_submit(
+    a_coords: Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray],
+    r_bytes: np.ndarray,  # (Nr, 32)
+    scalars: Sequence[int],  # length Na + Nr, A block first
+):
+    """Cached-A variant of rlc_check_submit (A predecompressed, R by bytes)."""
+    na = a_coords[0].shape[-1]
+    nr = r_bytes.shape[0]
+    n = na + nr
+    digits = scalars_to_bytes(scalars, n)
+    perm, node_idx = sort_windows(digits)
+    fctx = make_ctx((nr,))
+    return _rlc_cached_jit(
+        *a_coords,
+        np.ascontiguousarray(r_bytes.T),
+        perm,
+        node_idx,
+        fctx,
+        make_small_ctx(),
+    )
+
+
+def rlc_check_cached(
+    a_coords: Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray],
+    r_bytes: np.ndarray,
+    scalars: Sequence[int],
+) -> Tuple[bool, np.ndarray]:
+    batch_ok, r_ok = rlc_check_cached_submit(a_coords, r_bytes, scalars)
+    return bool(np.asarray(batch_ok)), np.asarray(r_ok)

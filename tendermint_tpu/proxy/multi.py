@@ -26,6 +26,37 @@ def local_client_creator(app: abci.Application) -> ClientCreator:
     return create
 
 
+def socket_client_creator(addr: str) -> ClientCreator:
+    def create() -> ABCIClient:
+        from tendermint_tpu.abci.socket import SocketClient
+
+        return SocketClient(addr)
+
+    return create
+
+
+def grpc_client_creator(addr: str) -> ClientCreator:
+    def create() -> ABCIClient:
+        from tendermint_tpu.abci.grpc import GrpcClient
+
+        return GrpcClient(addr)
+
+    return create
+
+
+def default_client_creator(proxy_app: str, transport: str, app=None) -> ClientCreator:
+    """The reference's DefaultClientCreator (proxy/client.go): an address in
+    proxy_app selects a remote transport ("socket" default, "grpc"); empty
+    means run the in-process app."""
+    if proxy_app:
+        if transport == "grpc":
+            return grpc_client_creator(proxy_app)
+        return socket_client_creator(proxy_app)
+    if app is None:
+        raise ValueError("no proxy_app address and no in-process app")
+    return local_client_creator(app)
+
+
 class AppConns:
     def __init__(self, creator: ClientCreator):
         self._creator = creator

@@ -78,6 +78,7 @@ class RPCServer:
             "block_results": self._block_results,
             "block_by_hash": self._block_by_hash,
             "broadcast_evidence": self._broadcast_evidence,
+            "check_tx": self._check_tx,
             "dial_peers": self._dial_peers,
             "dial_seeds": self._dial_seeds,
             "unsafe_flush_mempool": self._unsafe_flush_mempool,
@@ -257,6 +258,21 @@ class RPCServer:
             "data": _b64(res.data),
             "log": res.log,
             "hash": tmhash.sum256(tx).hex().upper(),
+        }
+
+    async def _check_tx(self, params) -> dict:
+        """Run CheckTx against the app WITHOUT adding the tx to the mempool
+        (reference: rpc/core/mempool.go CheckTx, routes.go:26)."""
+        tx = self._decode_tx_param(params)
+        res = await asyncio.get_event_loop().run_in_executor(
+            None, self.node.proxy_app.mempool.check_tx, abci.RequestCheckTx(tx=tx)
+        )
+        return {
+            "code": res.code,
+            "data": _b64(res.data),
+            "log": res.log,
+            "gas_wanted": str(res.gas_wanted),
+            "gas_used": str(res.gas_used),
         }
 
     async def _broadcast_tx_commit(self, params) -> dict:

@@ -125,6 +125,10 @@ class VoteSet:
         out, self._conflicts = self._conflicts, []
         return out
 
+    def pending_count(self) -> int:
+        """Number of deferred (accepted-but-unverified) votes awaiting flush()."""
+        return len(self._pending)
+
     # -- adding votes -------------------------------------------------------
 
     def _get_vote(self, idx: int, block_key: bytes) -> Optional[Vote]:

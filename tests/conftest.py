@@ -12,6 +12,8 @@ import os
 # JAX_PLATFORMS=axon); override with TMTPU_TEST_PLATFORM to test on hardware.
 os.environ["JAX_PLATFORMS"] = os.environ.get("TMTPU_TEST_PLATFORM", "cpu")
 
+_platform = os.environ.get("TMTPU_TEST_PLATFORM", "cpu")
+
 # Persistent compilation cache: the ed25519 scan kernel is expensive to compile
 # on CPU; cache it across pytest runs.
 os.environ.setdefault(
@@ -21,3 +23,11 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The env var alone is NOT enough: an injected sitecustomize (axon tooling)
+# registers the TPU platform and overrides JAX_PLATFORMS at interpreter
+# start, so tests silently ran against the TPU tunnel (slow remote compiles,
+# concurrent-compile flakes). jax.config.update wins over both — force it.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)

@@ -1,0 +1,105 @@
+"""RLC (random-linear-combination) batch verification — the Pippenger MSM
+fast path (ops/msm_jax.py + crypto/batch.py).
+
+Differential-tested against the host reference implementation and the
+per-signature kernel. Semantics under test: the RLC path must return the
+SAME mask as per-signature verification in every case — directly when the
+combined check passes, via fallback when it fails
+(reference semantics: types/validator_set.go:680-702, one accept/reject per
+signature).
+
+Shapes are kept to the production lane buckets (Na=64 -> 128 lanes) so the
+persistent compile cache is shared with real use.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "jax")
+
+from tendermint_tpu.crypto import batch as B
+from tendermint_tpu.crypto.keys import gen_ed25519
+
+
+def make_batch(n, seed=0, msg_len=40):
+    pubkeys, msgs, sigs = [], [], []
+    for i in range(n):
+        priv = gen_ed25519(bytes([seed]) * 31 + bytes([i]))
+        msg = b"msm-%03d-" % i + b"x" * (msg_len - 8)
+        pubkeys.append(priv.pub_key().bytes())
+        msgs.append(msg)
+        sigs.append(priv.sign(msg))
+    return pubkeys, msgs, sigs
+
+
+@pytest.fixture
+def rlc_on(monkeypatch):
+    monkeypatch.setattr(B, "RLC_MIN", 1)
+    monkeypatch.setenv("TMTPU_RLC", "1")
+    # the test env exposes 8 virtual CPU devices; disable mesh routing so the
+    # RLC path (single-device production shape) is what runs
+    monkeypatch.setenv("TMTPU_SHARDED", "0")
+    B._A_CACHE.clear()
+
+
+def test_rlc_all_valid_and_cached_path(rlc_on):
+    pubkeys, msgs, sigs = make_batch(40)
+    # first call: uncached kernel; fills the pubkey cache
+    mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+    assert mask.all()
+    assert all(bytes(pk) in B._A_CACHE for pk in pubkeys)
+    # second call: cached-A kernel; same verdict
+    mask2 = B.verify_batch_jax(pubkeys, msgs, sigs)
+    assert mask2.all()
+    assert B.LAST_RLC_TIMINGS.get("cached") is True
+
+
+def test_rlc_bad_sig_falls_back_to_exact_mask(rlc_on):
+    pubkeys, msgs, sigs = make_batch(40)
+    bad = bytearray(sigs[7])
+    bad[3] ^= 0xFF
+    sigs[7] = bytes(bad)
+    mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+    expected = np.ones(40, dtype=bool)
+    expected[7] = False
+    assert (mask == expected).all()
+
+
+def test_rlc_wrong_message_falls_back(rlc_on):
+    pubkeys, msgs, sigs = make_batch(40)
+    msgs[0] = b"tampered" + msgs[0][8:]
+    msgs[13] = b"tampered" + msgs[13][8:]
+    mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+    expected = np.ones(40, dtype=bool)
+    expected[0] = expected[13] = False
+    assert (mask == expected).all()
+
+
+def test_rlc_invalid_encodings_and_precheck(rlc_on):
+    pubkeys, msgs, sigs = make_batch(40)
+    # non-canonical s (>= L): rejected host-side, excluded from the batch eq
+    from tendermint_tpu.crypto.ed25519_ref import L
+
+    s_big = (L + 5).to_bytes(32, "little")
+    sigs[3] = sigs[3][:32] + s_big
+    # invalid pubkey encoding (y >= p, not on curve)
+    pubkeys[11] = b"\xff" * 32
+    mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+    expected = np.ones(40, dtype=bool)
+    expected[3] = expected[11] = False
+    assert (mask == expected).all()
+
+
+def test_rlc_matches_cpu_backend_on_mixed_validity(rlc_on):
+    pubkeys, msgs, sigs = make_batch(40, seed=2)
+    # corrupt a scattering of rows in different ways
+    sigs[1] = sigs[2]  # signature for the wrong message/key
+    msgs[20] = msgs[21]
+    rng = np.random.default_rng(3)
+    junk = bytes(rng.integers(0, 256, 64, dtype=np.uint8))
+    sigs[39] = junk[:32] + (int.from_bytes(junk[32:], "little") % (1 << 250)).to_bytes(32, "little")
+    got = B.verify_batch_jax(pubkeys, msgs, sigs)
+    want = B.verify_batch_cpu(pubkeys, msgs, sigs)
+    assert (got == want).all()

@@ -21,7 +21,7 @@ from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
 os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
 
 
-def make_net(n: int, tmp_path, chain="multinode-chain"):
+def make_net(n: int, tmp_path, chain="multinode-chain", defer_votes=False):
     privs = [FilePV(gen_ed25519(bytes([10 + i]) * 32)) for i in range(n)]
     gen = GenesisDoc(
         chain_id=chain,
@@ -36,6 +36,7 @@ def make_net(n: int, tmp_path, chain="multinode-chain"):
         cfg.p2p.laddr = "tcp://127.0.0.1:0"
         # each node gets its own WAL dir
         cfg.consensus.wal_path = str(tmp_path / f"wal{i}" / "wal")
+        cfg.consensus.defer_vote_verification = defer_votes
         node = Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
         nodes.append(node)
     return nodes
@@ -206,6 +207,77 @@ def test_byzantine_equivocator_produces_evidence(tmp_path):
                             assert ev.vote_a.validator_address == byz.priv_validator.get_pub_key().address()
                 await asyncio.sleep(0.1)
             assert found, "duplicate vote evidence never committed"
+        finally:
+            await stop_all(nodes)
+
+    asyncio.run(run())
+
+
+def test_deferred_vote_verification_liveness_and_evidence(tmp_path):
+    """With defer_vote_verification=true, votes queue unverified and flush as
+    device batches on receive-loop batch boundaries (cs_state.py
+    _flush_deferred_votes). The net must stay live (blocks commit) AND an
+    equivocator's conflicting votes — discovered at flush time, not
+    add_vote time — must still become DuplicateVoteEvidence
+    (reference semantics: types/vote_set.go:143 conflict detection +
+    consensus/state.go:1829 evidence path)."""
+
+    async def run():
+        nodes = make_net(4, tmp_path, chain="defer-chain", defer_votes=True)
+        byz = nodes[0]
+        try:
+            await start_and_connect(nodes)
+
+            cs = byz.consensus
+            orig_do_prevote = cs._default_do_prevote
+
+            def byz_do_prevote(height, round_):
+                orig_do_prevote(height, round_)
+                import dataclasses
+                import time as _time
+
+                from tendermint_tpu.consensus.messages import VoteMessage, encode_message
+                from tendermint_tpu.consensus.reactor import VOTE_CHANNEL
+                from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+                from tendermint_tpu.types.vote import Vote
+
+                rs = cs.rs
+                if rs.proposal_block is None:
+                    return
+                addr = byz.priv_validator.get_pub_key().address()
+                idx, _ = rs.validators.get_by_address(addr)
+                vote = Vote(
+                    type=SignedMsgType.PREVOTE, height=height, round=round_,
+                    block_id=BlockID(b"", PartSetHeader()),
+                    timestamp_ns=_time.time_ns(),
+                    validator_address=addr, validator_index=idx,
+                )
+                sig = byz.priv_validator.priv_key.sign(vote.sign_bytes(cs.state.chain_id))
+                vote = dataclasses.replace(vote, signature=sig)
+
+                async def gossip():
+                    await byz.switch.broadcast(VOTE_CHANNEL, encode_message(VoteMessage(vote)))
+
+                asyncio.ensure_future(gossip())
+
+            cs.do_prevote = byz_do_prevote
+
+            # liveness: all nodes reach height 4 with deferred verification on
+            await asyncio.gather(*(n.wait_for_height(4, timeout=180) for n in nodes))
+
+            # evidence: some honest node commits the equivocation
+            deadline = asyncio.get_event_loop().time() + 60
+            found = False
+            while asyncio.get_event_loop().time() < deadline and not found:
+                for n in nodes[1:]:
+                    for h in range(1, n.block_store.height + 1):
+                        b = n.block_store.load_block(h)
+                        if b and len(b.evidence) > 0:
+                            found = True
+                            ev = b.evidence[0]
+                            assert ev.vote_a.validator_address == byz.priv_validator.get_pub_key().address()
+                await asyncio.sleep(0.1)
+            assert found, "deferred flush dropped the equivocation evidence"
         finally:
             await stop_all(nodes)
 

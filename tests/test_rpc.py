@@ -107,6 +107,28 @@ def test_rpc_http_client_end_to_end(tmp_path):
     asyncio.run(run())
 
 
+def test_check_tx_route(tmp_path):
+    """check_tx runs CheckTx against the app without mempool admission
+    (reference: rpc/core/routes.go:26, rpc/core/mempool.go CheckTx)."""
+
+    async def run():
+        node = make_node(tmp_path)
+        await node.start()
+        try:
+            client = LocalClient(node)
+            res = await client.call("check_tx", tx="0x" + b"k=v".hex())
+            assert res["code"] == 0
+            # the tx must NOT have entered the mempool
+            assert node.mempool.size() == 0
+            # kvstore rejects empty txs with code 1
+            bad = await client.call("check_tx", tx="")
+            assert bad["code"] == 1
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+
 def test_broadcast_evidence_route(tmp_path):
     async def run():
         node = make_node(tmp_path)
