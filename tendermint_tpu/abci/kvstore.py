@@ -64,14 +64,17 @@ class KVStoreApplication(abci.Application):
         ]
         return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK, events=events)
 
+    def _compute_app_hash(self) -> bytes:
+        # app hash = encoded size (mirrors reference kvstore.go:113)
+        return struct.pack(">Q", self.size)
+
     def commit(self) -> abci.ResponseCommit:
         for key, value in self.staged:
             self.db.set(b"kv/" + key, value)
             self.size += 1
         self.staged.clear()
         self.height += 1
-        # app hash = encoded size (mirrors reference kvstore.go:113)
-        self.app_hash = struct.pack(">Q", self.size)
+        self.app_hash = self._compute_app_hash()
         self.db.set(b"__size__", self.size.to_bytes(8, "big"))
         self.db.set(b"__height__", self.height.to_bytes(8, "big"))
         self.db.set(b"__apphash__", self.app_hash)
@@ -184,6 +187,45 @@ class KVStoreApplication(abci.Application):
                 log="exists" if value is not None else "does not exist",
             )
         return abci.ResponseQuery(code=1, log=f"unknown path {req.path}")
+
+
+class MerkleKVStoreApplication(KVStoreApplication):
+    """KVStore whose app hash is the SimpleMap merkle root over its pairs,
+    with `prove=true` queries answered by ValueOp proofs that chain to the
+    header's app_hash — the tree shape crypto/merkle/proof_value.go:14
+    verifies. This is what the light proxy's verified abci_query runs
+    against (light/rpc/client.go:116)."""
+
+    def _pairs(self) -> Dict[bytes, bytes]:
+        return {
+            k[len(b"kv/"):]: v for k, v in sorted(self.db.iterate_prefix(b"kv/"))
+        }
+
+    def _compute_app_hash(self) -> bytes:
+        from tendermint_tpu.crypto.proof_ops import simple_map_proofs
+
+        # One tree build per commit; proved queries reuse the per-key
+        # ValueOps until the next commit replaces them.
+        root, ops = simple_map_proofs(self._pairs())
+        self._proof_cache = (self.height, ops)
+        return root
+
+    def _proofs(self):
+        cache = getattr(self, "_proof_cache", None)
+        if cache is None or cache[0] != self.height:
+            from tendermint_tpu.crypto.proof_ops import simple_map_proofs
+
+            _, ops = simple_map_proofs(self._pairs())
+            cache = self._proof_cache = (self.height, ops)
+        return cache[1]
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        res = super().query(req)
+        if req.prove and res.code == abci.CODE_TYPE_OK and res.value:
+            vop = self._proofs().get(req.data)
+            if vop is not None:
+                res.proof_ops = [vop.proof_op()]
+        return res
 
 
 class PersistentKVStoreApplication(KVStoreApplication):

@@ -228,6 +228,9 @@ def run_replay(home: str, console: bool = False) -> None:
                     continue
                 if line.startswith(("n", "next")) or line == "":
                     parts = line.split()
+                    if len(parts) > 1 and not parts[1].isdigit():
+                        print("commands: n [count], rs, q")
+                        continue
                     count = int(parts[1]) if len(parts) > 1 else 1
                     for _ in range(count):
                         if i >= len(msgs):
@@ -454,7 +457,14 @@ def debug_dump(home: str, rpc_url: str, output: str) -> None:
             async def fetch():
                 client = HTTPClient(rpc_url)
                 try:
-                    for method in ("status", "net_info", "dump_consensus_state"):
+                    for method in (
+                        "status",
+                        "net_info",
+                        "dump_consensus_state",
+                        # stack/heap profiles (pprof analogs; need rpc.unsafe)
+                        "unsafe_dump_stacks",
+                        "unsafe_dump_heap",
+                    ):
                         try:
                             res = await client.call(method)
                             z.writestr(f"{method}.json", json.dumps(res, indent=2))
@@ -586,6 +596,12 @@ def main(argv=None) -> int:
     sub.add_parser("replay-console", help="interactive WAL replay (n/rs/q)")
 
     sp = sub.add_parser(
+        "probe-upnp", help="probe the local NAT for UPnP port-mapping support"
+    )
+    sp.add_argument("--port", type=int, default=26656)
+    sp.add_argument("--timeout", type=float, default=3.0)
+
+    sp = sub.add_parser(
         "debug", help="capture a debug dump (node state over RPC + config + WAL) into a zip"
     )
     sp.add_argument("--rpc", default="", help="RPC URL of the running node (optional)")
@@ -655,6 +671,17 @@ def main(argv=None) -> int:
         run_replay(args.home, console=False)
     elif args.cmd == "replay-console":
         run_replay(args.home, console=True)
+    elif args.cmd == "probe-upnp":
+        # (reference: cmd/tendermint/commands/probe_upnp.go)
+        from tendermint_tpu.p2p.upnp import UPNPError, probe
+
+        try:
+            caps = asyncio.run(
+                probe(int_port=args.port, ext_port=args.port, timeout=args.timeout)
+            )
+            print(json.dumps(caps))
+        except UPNPError as e:
+            print(json.dumps({"upnp": False, "error": str(e)}))
     elif args.cmd == "debug":
         debug_dump(args.home, args.rpc, args.output)
         print(json.dumps({"dump": args.output}))

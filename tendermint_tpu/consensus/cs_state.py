@@ -900,7 +900,12 @@ class ConsensusState:
             flushed = votes_before.flush_all()
             for err in votes_before.drain_conflicts():
                 self._handle_vote_conflict(err)
-            for vtype, vround, failed in flushed:
+            for vtype, vround, committed, failed in flushed:
+                # Publish only now: enqueue time would advertise (HasVote)
+                # signatures we have not verified, letting a forged vote
+                # suppress gossip of the genuine one.
+                for vote in committed:
+                    self.event_bus.publish_vote(vote)
                 if failed:
                     logger.warning(
                         "deferred flush: %d invalid %s signatures at round %d",
@@ -914,7 +919,9 @@ class ConsensusState:
                     break
                 self._check_progress_after_vote(vtype, vround)
         if rs.last_commit is not None and rs.last_commit.pending_count() > 0:
-            rs.last_commit.flush()
+            committed, _failed = rs.last_commit.flush()
+            for vote in committed:
+                self.event_bus.publish_vote(vote)
             for err in rs.last_commit.pop_conflicts():
                 self._handle_vote_conflict(err)
             if self.config.skip_timeout_commit and rs.last_commit.has_all():
@@ -931,7 +938,8 @@ class ConsensusState:
             added = rs.last_commit.add_vote(vote)
             if not added:
                 return False
-            self.event_bus.publish_vote(vote)
+            if added != "pending":  # unverified: published at flush instead
+                self.event_bus.publish_vote(vote)
             if self.config.skip_timeout_commit and rs.last_commit.has_all():
                 self._enter_new_round(rs.height, 0)
             return True
@@ -942,6 +950,12 @@ class ConsensusState:
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return False
+        if added == "pending":
+            # Deferred verification: the vote is queued, not verified — do
+            # NOT publish (the reactor would broadcast HasVote and peers
+            # would stop gossiping the genuine vote). flush publishes the
+            # ones that verify.
+            return True
         self.event_bus.publish_vote(vote)
         self._check_progress_after_vote(vote.type, vote.round)
         return True

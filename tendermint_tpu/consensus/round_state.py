@@ -79,16 +79,18 @@ class HeightVoteSet:
     def flush_all(self):
         """Flush every round vote set with deferred votes in one pass.
 
-        Returns [(type, round, failed_indices)] for each set that had
-        pending votes — the caller re-runs the 2/3 progress checks for those
-        (type, round) pairs and drains conflicts via drain_conflicts().
+        Returns [(type, round, committed_votes, failed_indices)] for each
+        set that had pending votes — the caller publishes the committed
+        votes (they were NOT published at enqueue time), re-runs the 2/3
+        progress checks for those (type, round) pairs, and drains conflicts
+        via drain_conflicts().
         """
         out = []
         for round_, (prevotes, precommits) in sorted(self._round_vote_sets.items()):
             for vs in (prevotes, precommits):
                 if vs.pending_count() > 0:
-                    failed = vs.flush()
-                    out.append((vs.signed_msg_type, round_, failed))
+                    committed, failed = vs.flush()
+                    out.append((vs.signed_msg_type, round_, committed, failed))
         return out
 
     def drain_conflicts(self):

@@ -30,6 +30,8 @@ import numpy as np
 
 from tendermint_tpu.crypto.ed25519_ref import L
 
+L8 = 8 * L  # full curve-group order; scalar modulus for torsion-exact RLC
+
 _BUCKET_SIZES = [2**i for i in range(17)]  # jit shape buckets: 1..65536
 
 
@@ -152,64 +154,114 @@ def prepare_batch(
 
 
 def _precheck_and_hash(
-    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    key_types: Sequence[str] | None = None,
 ):
-    """Shared host prep: length/canonical-s checks + h = SHA512(R||A||M) mod L.
+    """Shared host prep: length/canonical-s checks + the per-row verification
+    scalar — h = SHA512(R||A||M) mod L for ed25519 rows, the merlin
+    transcript challenge k for sr25519 rows (crypto/sr25519.py; reference
+    crypto/sr25519/pubkey.go:34).
 
     Returns (precheck bool[n], a_rows (n,32) u8, r_rows (n,32) u8,
-    s_ints list[int], h_ints list[int]); rows failing precheck have zeroed
+    s_ints list[int], hk_ints list[int]); rows failing precheck have zeroed
     entries."""
     n = len(pubkeys)
     precheck = np.zeros(n, dtype=bool)
-    a_rows = np.zeros((n, 32), dtype=np.uint8)
-    r_rows = np.zeros((n, 32), dtype=np.uint8)
+    a_buf = bytearray(32 * n)
+    r_buf = bytearray(32 * n)
     s_ints = [0] * n
-    h_ints = [0] * n
+    hk_ints = [0] * n
     sha512 = hashlib.sha512
+    from_bytes = int.from_bytes
     for i in range(n):
         pk, msg, sig = bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i])
         if len(pk) != 32 or len(sig) != 64:
             continue
-        s_int = int.from_bytes(sig[32:], "little")
-        if s_int >= L:
-            continue  # non-canonical s: reject without device work
+        if key_types is not None and key_types[i] == "sr25519":
+            if not (sig[63] & 0x80):
+                continue  # schnorrkel marker bit must be set
+            s_int = from_bytes(sig[32:63] + bytes([sig[63] & 0x7F]), "little")
+            if s_int >= L:
+                continue
+            from tendermint_tpu.crypto.sr25519 import (
+                _context_transcript,
+                _scalar_from_wide,
+                _sign_transcript,
+            )
+
+            t = _sign_transcript(_context_transcript(msg), pk)
+            t.append_message(b"sign:R", sig[:32])
+            hk_ints[i] = _scalar_from_wide(t.challenge_bytes(b"sign:c", 64))
+        else:
+            s_int = from_bytes(sig[32:], "little")
+            if s_int >= L:
+                continue  # non-canonical s: reject without device work
+            hk_ints[i] = (
+                from_bytes(sha512(sig[:32] + pk + msg).digest(), "little") % L
+            )
         precheck[i] = True
-        a_rows[i] = np.frombuffer(pk, dtype=np.uint8)
-        r_rows[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+        off = 32 * i
+        a_buf[off : off + 32] = pk
+        r_buf[off : off + 32] = sig[:32]
         s_ints[i] = s_int
-        h_ints[i] = int.from_bytes(sha512(sig[:32] + pk + msg).digest(), "little") % L
-    return precheck, a_rows, r_rows, s_ints, h_ints
+    a_rows = np.frombuffer(bytes(a_buf), dtype=np.uint8).reshape(n, 32)
+    r_rows = np.frombuffer(bytes(r_buf), dtype=np.uint8).reshape(n, 32)
+    return precheck, a_rows, r_rows, s_ints, hk_ints
 
 
 # ---------------------------------------------------------------------------
-# Decompressed-pubkey cache for the RLC path. Consensus verifies the same
-# validator keys every height; decompression (a ~250-mul sqrt chain per
-# point) is the single largest per-lane cost in the MSM kernel, so cache the
-# extended coordinates keyed by the 32-byte encoding.
+# Decoded-pubkey cache for the RLC path. Consensus verifies the same
+# validator keys every height; decoding (a ~250-mul sqrt chain per point) is
+# the single largest per-lane cost in the MSM kernel, so cache the extended
+# coordinates keyed by key type + the 32-byte encoding (ed25519 compressed
+# and ristretto255 encodings share the byte space but decode differently).
 
-_A_CACHE: dict = {}  # pubkey bytes -> (x, y, z, t) each (20,) int32, or None if invalid
+_A_CACHE: dict = {}  # b"e"/b"s" + pubkey bytes -> column index in _A_STORE, or None
 _A_CACHE_MAX = 65536
+# Contiguous coordinate store: one fancy-index gather builds the whole A
+# block instead of a 10k-iteration Python loop (see _a_block).
+_A_STORE = np.empty((4, 20, 1024), dtype=np.int32)
+_A_STORE_LEN = 0
 
 
-def _fill_a_cache(rows: "np.ndarray") -> None:
-    """Decompress unique pubkey rows on device and populate the cache."""
-    from tendermint_tpu.ops.msm_jax import decompress_rows
+def _cache_key(pk: bytes, key_type: str) -> bytes:
+    return (b"s" if key_type == "sr25519" else b"e") + pk
 
+
+def _fill_a_cache(rows: "np.ndarray", key_type: str = "ed25519") -> None:
+    """Decode unique pubkey rows on device and populate the cache."""
+    global _A_STORE, _A_STORE_LEN
+    if key_type == "sr25519":
+        from tendermint_tpu.ops.ristretto_jax import decode_rows as _decode
+    else:
+        from tendermint_tpu.ops.msm_jax import decompress_rows as _decode
+
+    prefix = b"s" if key_type == "sr25519" else b"e"
     uniq = {bytes(r.tobytes()) for r in rows}
-    missing = [k for k in uniq if k not in _A_CACHE]
+    missing = [k for k in uniq if prefix + k not in _A_CACHE]
     if not missing:
         return
-    missing = missing[:_A_CACHE_MAX]  # never cache beyond capacity
-    coords, ok = decompress_rows(
+    missing = missing[:_A_CACHE_MAX]
+    if _A_STORE_LEN + len(missing) > _A_CACHE_MAX:
+        # store exhausted: full reset (validator churn past 64k unique keys)
+        _A_CACHE.clear()
+        _A_STORE_LEN = 0
+    while _A_STORE.shape[2] < min(_A_CACHE_MAX, _A_STORE_LEN + len(missing)):
+        _A_STORE = np.concatenate([_A_STORE, np.empty_like(_A_STORE)], axis=2)
+    coords, ok = _decode(
         np.stack([np.frombuffer(k, dtype=np.uint8) for k in missing])
     )
-    while _A_CACHE and len(_A_CACHE) + len(missing) > _A_CACHE_MAX:
-        _A_CACHE.pop(next(iter(_A_CACHE)))
     for j, k in enumerate(missing):
         if ok[j]:
-            _A_CACHE[k] = tuple(np.ascontiguousarray(coords[c][:, j]) for c in range(4))
+            col = _A_STORE_LEN
+            for c in range(4):
+                _A_STORE[c, :, col] = coords[c][:, j]
+            _A_CACHE[prefix + k] = col
+            _A_STORE_LEN += 1
         else:
-            _A_CACHE[k] = None
+            _A_CACHE[prefix + k] = None
 
 
 class _RlcCall:
@@ -219,26 +271,54 @@ class _RlcCall:
     dispatch overlaps the next batch's host prep (hashing, sorting, scalar
     math) with the previous batch's device execution."""
 
-    __slots__ = ("precheck", "n", "na", "cached", "dev", "a_rows", "prep_seconds")
+    __slots__ = (
+        "precheck", "n", "na", "mode", "dev", "a_rows", "prep_seconds",
+        "ed_pos", "sr_pos",
+    )
 
-    def __init__(self, precheck, n, na, cached, dev, a_rows, prep_seconds):
+    def __init__(self, precheck, n, na, mode, dev, a_rows, prep_seconds,
+                 ed_pos=None, sr_pos=None):
         self.precheck = precheck
         self.n = n
         self.na = na
-        self.cached = cached
+        self.mode = mode  # "plain" | "cached" | "mixed"
         self.dev = dev
         self.a_rows = a_rows
         self.prep_seconds = prep_seconds
+        self.ed_pos = ed_pos  # mixed: row index per ed R lane
+        self.sr_pos = sr_pos  # mixed: row index per sr R lane
 
 
 # Timing of the last completed RLC call (host-prep vs total), for bench.py.
 LAST_RLC_TIMINGS: dict = {}
 
 
+def _sample_z(rng, n: int, precheck) -> list:
+    """Random RLC coefficients: ~124-bit, nonzero, and ≡ 0 (mod 8) so every
+    lane's cofactor-torsion component is annihilated exactly (see
+    ops/msm_jax.py docstring). 0 for excluded rows."""
+    zw = rng.integers(0, 1 << 64, size=(n, 2), dtype=np.uint64)
+    return [
+        ((((int(zw[i, 0]) & ((1 << 57) - 1)) << 64) | int(zw[i, 1]) | 1) << 3)
+        if precheck[i]
+        else 0
+        for i in range(n)
+    ]
+
+
 def _rlc_submit(
-    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    key_types: Sequence[str] | None = None,
 ) -> _RlcCall:
-    """Host prep + device submit of the RLC combined check (no sync)."""
+    """Host prep + device submit of the RLC combined check (no sync).
+
+    Pure-ed25519 batches use the plain kernel on first sight of a validator
+    set (A decoded in-kernel, cache filled at finish) and the cached-A kernel
+    in steady state. Mixed ed25519+sr25519 batches always prefill the typed
+    pubkey cache (both decoders) and run the mixed cached kernel with
+    separate ed/sr R-lane blocks."""
     import time as _time
 
     from tendermint_tpu.crypto.ed25519_ref import BASE, point_compress
@@ -246,26 +326,96 @@ def _rlc_submit(
 
     t0 = _time.perf_counter()
     n = len(pubkeys)
-    precheck, a_rows, r_rows, s_ints, h_ints = _precheck_and_hash(pubkeys, msgs, sigs)
+    mixed = key_types is not None and any(t == "sr25519" for t in key_types)
+    precheck, a_rows, r_rows, s_ints, hk_ints = _precheck_and_hash(
+        pubkeys, msgs, sigs, key_types if mixed else None
+    )
+
+    types = key_types if mixed else ["ed25519"] * n
+    ckeys = [_cache_key(bytes(pubkeys[i]), types[i]) for i in range(n)]
+
+    if mixed:
+        # Prefill the typed cache so every included lane has coordinates.
+        # Two passes: the second-type fill can trigger a full cache reset
+        # (store exhaustion under extreme validator churn), orphaning keys
+        # the first pass just cached — the retry refills them; after a reset
+        # the store has capacity for the whole batch, so one retry suffices.
+        for _attempt in range(2):
+            for kt in ("ed25519", "sr25519"):
+                rows_kt = a_rows[
+                    [
+                        precheck[i]
+                        and types[i] == kt
+                        and ckeys[i] not in _A_CACHE
+                        for i in range(n)
+                    ]
+                ]
+                if len(rows_kt):
+                    _fill_a_cache(rows_kt, kt)
+            if all(ckeys[i] in _A_CACHE for i in range(n) if precheck[i]):
+                break
 
     # Exclude rows whose pubkey is a cached-invalid encoding: their verdict
     # is False regardless, and excluding them keeps the batch equation clean.
-    keys = [bytes(pubkeys[i]) for i in range(n)]
     for i in range(n):
-        if precheck[i] and _A_CACHE.get(keys[i], True) is None:
+        if precheck[i] and _A_CACHE.get(ckeys[i], True) is None:
             precheck[i] = False
 
-    # Random 128-bit coefficients, forced odd (z=0 would silently exclude a
-    # signature from the check). OS-entropy seeded per call.
-    rng = np.random.default_rng()
-    zw = rng.integers(0, 1 << 64, size=(n, 2), dtype=np.uint64)
-    zs = [((int(zw[i, 0]) << 64) | int(zw[i, 1]) | 1) if precheck[i] else 0 for i in range(n)]
+    rng = np.random.default_rng()  # OS-entropy seeded per call
+    zs = _sample_z(rng, n, precheck)
 
-    w_scalars = [zs[i] * h_ints[i] % L if precheck[i] else 0 for i in range(n)]
+    # A-lane scalars mod 8L (exact for points of any order; kills torsion
+    # since z ≡ 0 mod 8 survives the reduction), B-lane scalar mod L.
+    w_scalars = [zs[i] * hk_ints[i] % L8 if precheck[i] else 0 for i in range(n)]
     u = sum(zs[i] * s_ints[i] for i in range(n) if precheck[i]) % L
 
     b_enc = np.frombuffer(point_compress(BASE), dtype=np.uint8)
     na = _lane_bucket(n + 1)
+
+    included = [ckeys[i] for i in range(n) if precheck[i]]
+    cached = bool(included) and all(k in _A_CACHE for k in included)
+
+    def _a_block():
+        bx, by, bz, bt = msm_jax.basepoint_coords()
+        block = np.empty((4, 20, na), dtype=np.int32)
+        block[0] = bx[:, None]
+        block[1] = by[:, None]
+        block[2] = bz[:, None]
+        block[3] = bt[:, None]
+        rows = np.flatnonzero(precheck)
+        if len(rows):
+            cols = np.fromiter(
+                (_A_CACHE[ckeys[i]] for i in rows), dtype=np.int64, count=len(rows)
+            )
+            block[:, :, rows] = _A_STORE[:, :, cols]
+        return block[0], block[1], block[2], block[3]
+
+    if mixed:
+        ed_pos = [i for i in range(n) if types[i] != "sr25519"]
+        sr_pos = [i for i in range(n) if types[i] == "sr25519"]
+        ne = _lane_bucket(max(len(ed_pos), 1))
+        ns = _lane_bucket(max(len(sr_pos), 1))
+        ed_r = np.tile(b_enc, (ne, 1))
+        sr_r = np.zeros((ns, 32), dtype=np.uint8)  # identity: valid ristretto
+        for j, i in enumerate(ed_pos):
+            if precheck[i]:
+                ed_r[j] = r_rows[i]
+        for j, i in enumerate(sr_pos):
+            if precheck[i]:
+                sr_r[j] = r_rows[i]
+        scalars = [0] * (na + ne + ns)
+        scalars[:n] = w_scalars
+        scalars[n] = (L - u) % L
+        for j, i in enumerate(ed_pos):
+            scalars[na + j] = zs[i]
+        for j, i in enumerate(sr_pos):
+            scalars[na + ne + j] = zs[i]
+        dev = msm_jax.rlc_check_cached_mixed_submit(_a_block(), ed_r, sr_r, scalars)
+        return _RlcCall(
+            precheck, n, na, "mixed", dev, None, _time.perf_counter() - t0,
+            ed_pos=np.asarray(ed_pos, dtype=np.int64),
+            sr_pos=np.asarray(sr_pos, dtype=np.int64),
+        )
 
     # A block: [A_0..A_{n-1}, B, pads]; excluded/pad lanes are the basepoint
     # encoding with scalar 0 (bucket 0 is never summed).
@@ -278,41 +428,39 @@ def _rlc_submit(
     scalars[n] = (L - u) % L
     scalars[na : na + n] = [zs[i] if precheck[i] else 0 for i in range(n)]
 
-    included = [keys[i] for i in range(n) if precheck[i]]
-    cached = bool(included) and all(k in _A_CACHE for k in included)
     if cached:
-        bx, by, bz, bt = msm_jax.basepoint_coords()
-        ax = np.empty((20, na), dtype=np.int32)
-        ay = np.empty((20, na), dtype=np.int32)
-        az = np.empty((20, na), dtype=np.int32)
-        at = np.empty((20, na), dtype=np.int32)
-        ax[:] = bx[:, None]
-        ay[:] = by[:, None]
-        az[:] = bz[:, None]
-        at[:] = bt[:, None]
-        for i in range(n):
-            if precheck[i]:
-                cx, cy, cz, ct = _A_CACHE[keys[i]]
-                ax[:, i], ay[:, i], az[:, i], at[:, i] = cx, cy, cz, ct
-        dev = msm_jax.rlc_check_cached_submit((ax, ay, az, at), pts_r, scalars)
+        dev = msm_jax.rlc_check_cached_submit(_a_block(), pts_r, scalars)
     else:
         pts_a = np.tile(b_enc, (na, 1))
         if precheck.any():
             pts_a[:n][precheck] = a_rows[precheck]
         dev = msm_jax.rlc_check_submit(np.concatenate([pts_a, pts_r], axis=0), scalars)
     return _RlcCall(
-        precheck, n, na, cached, dev, a_rows if not cached else None,
-        _time.perf_counter() - t0,
+        precheck, n, na, "cached" if cached else "plain", dev,
+        a_rows if not cached else None, _time.perf_counter() - t0,
     )
 
 
 def _rlc_finish(call: _RlcCall) -> Optional[np.ndarray]:
     """Sync the device result; mask on success, None -> per-sig fallback."""
+    precheck, n, na = call.precheck, call.n, call.na
+    if call.mode == "mixed":
+        batch_ok_dev, ed_ok_dev, sr_ok_dev = call.dev
+        batch_ok = bool(np.asarray(batch_ok_dev))
+        ed_ok = np.asarray(ed_ok_dev)
+        sr_ok = np.asarray(sr_ok_dev)
+        lanes_ok = True
+        for j, i in enumerate(call.ed_pos):
+            if precheck[i] and not ed_ok[j]:
+                lanes_ok = False
+        for j, i in enumerate(call.sr_pos):
+            if precheck[i] and not sr_ok[j]:
+                lanes_ok = False
+        return precheck if (batch_ok and lanes_ok) else None
     batch_ok_dev, ok_dev = call.dev
     batch_ok = bool(np.asarray(batch_ok_dev))
     ok = np.asarray(ok_dev)
-    precheck, n, na = call.precheck, call.n, call.na
-    if call.cached:
+    if call.mode == "cached":
         lanes_ok = bool(ok[:n][precheck].all()) if precheck.any() else True
     else:
         lanes_ok = (
@@ -330,7 +478,10 @@ def _rlc_finish(call: _RlcCall) -> Optional[np.ndarray]:
 
 
 def _verify_batch_rlc(
-    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    key_types: Sequence[str] | None = None,
 ) -> Optional[np.ndarray]:
     """RLC fast path. Returns the bool mask if the combined check passes,
     or None when the caller must fall back to the per-signature kernel
@@ -338,12 +489,24 @@ def _verify_batch_rlc(
     import time as _time
 
     t0 = _time.perf_counter()
-    call = _rlc_submit(pubkeys, msgs, sigs)
-    mask = _rlc_finish(call)
+    try:
+        call = _rlc_submit(pubkeys, msgs, sigs, key_types)
+        mask = _rlc_finish(call)
+    except Exception:
+        # Any unexpected RLC-path failure (cache churn past capacity, device
+        # error) degrades to the always-correct per-signature fallback
+        # rather than propagating into the consensus receive loop.
+        import logging
+
+        logging.getLogger("tendermint_tpu.crypto.batch").exception(
+            "RLC fast path failed; falling back to per-signature verification"
+        )
+        return None
     LAST_RLC_TIMINGS.update(
         prep_ms=call.prep_seconds * 1e3,
         total_ms=(_time.perf_counter() - t0) * 1e3,
-        cached=call.cached,
+        cached=call.mode == "cached",
+        mode=call.mode,
     )
     return mask
 
@@ -418,9 +581,10 @@ def verify_batch(
     """Verify N (pubkey, msg, sig) triples; returns bool[N].
 
     key_types: per-row key type ("ed25519"/"sr25519"); None means all
-    ed25519. Mixed sets (BASELINE config 5) route ed25519 rows through the
-    selected backend (TPU batch on "jax") and sr25519 rows through the host
-    schnorrkel path."""
+    ed25519. Mixed sets (BASELINE config 5) above RLC_MIN verify BOTH key
+    types in one device MSM (sr lanes ristretto-decoded,
+    ops/ristretto_jax.py); smaller mixed sets route ed25519 rows through the
+    selected backend and sr25519 rows through the host schnorrkel path."""
     if not (len(pubkeys) == len(msgs) == len(sigs)):
         raise ValueError("pubkeys/msgs/sigs length mismatch")
     if len(pubkeys) == 0:
@@ -428,6 +592,21 @@ def verify_batch(
     if key_types is not None and any(t != "ed25519" for t in key_types):
         from tendermint_tpu.crypto.sr25519 import sr25519_verify
 
+        be = backend or backend_default()
+        # Mixed sets above the RLC threshold verify both key types in ONE
+        # device MSM (ed lanes via compressed-edwards decode, sr lanes via
+        # ristretto decode; reference verifies each vote by its key type,
+        # types/vote_set.go:203 — serial there, one batch here).
+        if (
+            be == "jax"
+            and _rlc_enabled()
+            and len(pubkeys) >= RLC_MIN
+            and _sharded_runner() is None
+        ):
+            mask = _verify_batch_rlc(pubkeys, msgs, sigs, key_types)
+            if mask is not None:
+                LAST_JAX_PATH[0] = "rlc-mixed"
+                return mask
         out = np.zeros(len(pubkeys), dtype=bool)
         ed_idx = [i for i, t in enumerate(key_types) if t == "ed25519"]
         sr_idx = [i for i, t in enumerate(key_types) if t == "sr25519"]

@@ -6,7 +6,9 @@ runs this so wallets can point at localhost and get trust-minimized answers
 from an untrusted full node.
 
 Verified routes: commit, validators, block (header pinned to a verified
-light block), status. Everything else is forwarded as-is with a
+light block), status, and abci_query (merkle proof operators run against
+the verified header's app_hash — light/rpc/client.go:116 +
+crypto/merkle/proof_op.go). Everything else is forwarded as-is with a
 "light_client_verified": false marker.
 """
 
@@ -74,6 +76,8 @@ class LightProxy:
                 result = await self._block(params)
             elif method == "status":
                 result = await self._status(params)
+            elif method == "abci_query":
+                result = await self._abci_query(params)
             else:
                 result = await self.backend.call(method, **params)
                 if isinstance(result, dict):
@@ -144,6 +148,56 @@ class LightProxy:
                 f"backend block data at height {lb.height} does not hash to "
                 "the verified header's DataHash"
             )
+        raw["light_client_verified"] = True
+        return raw
+
+    async def _abci_query(self, params) -> dict:
+        """Proof-verified query: force prove=true, then run the returned
+        proof operators from the value up to the app_hash of the VERIFIED
+        header at response-height + 1 (AppHash for H lands in header H+1;
+        reference: light/rpc/client.go:80-125 ABCIQueryWithOptions)."""
+        import base64
+
+        from tendermint_tpu.crypto.proof_ops import (
+            KeyPath,
+            ProofOp,
+            default_proof_runtime,
+        )
+
+        raw = await self.backend.call(
+            "abci_query",
+            path=params.get("path", ""),
+            data=params.get("data", ""),
+            height=int(params.get("height", 0)),
+            prove=True,
+        )
+        resp = raw.get("response", {})
+        if int(resp.get("code", 0)) != 0:
+            raise ValueError(f"err response code: {resp.get('code')}")
+        key = base64.b64decode(resp.get("key") or "")
+        value = base64.b64decode(resp.get("value") or "")
+        height = int(resp.get("height") or 0)
+        ops_json = (resp.get("proofOps") or {}).get("ops") or []
+        if not key or not ops_json:
+            raise ValueError("empty tree (no key or no proof ops)")
+        if height <= 0:
+            raise ValueError("zero or negative query height")
+
+        lb = await self.lc.verify_light_block_at_height(height + 1)
+        ops = [
+            ProofOp(
+                o.get("type", ""),
+                base64.b64decode(o.get("key") or ""),
+                base64.b64decode(o.get("data") or ""),
+            )
+            for o in ops_json
+        ]
+        prt = default_proof_runtime()
+        kp = KeyPath().append_key(key)
+        if value:
+            prt.verify_value(ops, lb.header.app_hash, str(kp), value)
+        else:
+            prt.verify_absence(ops, lb.header.app_hash, str(kp))
         raw["light_client_verified"] = True
         return raw
 

@@ -173,6 +173,7 @@ class Node:
 
         self.rpc_server = None
         self.grpc_server = None
+        self.prometheus_server = None
         self._running = False
 
         # p2p (reference: node/node.go:754-793 createTransport/createSwitch)
@@ -294,6 +295,13 @@ class Node:
 
             self.grpc_server = GrpcBroadcastServer(self, self.config.rpc.grpc_laddr)
             self.grpc_server.start()
+        if self.config.instrumentation.prometheus:
+            from tendermint_tpu.libs.prometheus_server import PrometheusServer
+
+            self.prometheus_server = PrometheusServer(
+                self.metrics, self.config.instrumentation.prometheus_listen_addr
+            )
+            await self.prometheus_server.start()
         if self.state_sync:
             self._statesync_task = asyncio.create_task(
                 self._run_state_sync(), name="statesync"
@@ -357,6 +365,8 @@ class Node:
             await self.rpc_server.stop()
         if self.grpc_server is not None:
             self.grpc_server.stop()
+        if self.prometheus_server is not None:
+            await self.prometheus_server.stop()
         if self.switch is not None:
             await self.switch.stop()
         await self.consensus.stop()

@@ -1,0 +1,99 @@
+"""Batched ristretto255 decode on TPU (JAX).
+
+Device-side point decode for sr25519 validator keys and signature R values,
+so mixed ed25519+sr25519 commits verify in ONE device batch (the host path
+is crypto/sr25519.ristretto_decode; reference semantics:
+crypto/sr25519/pubkey.go:34 via go-schnorrkel/ristretto255).
+
+Decode (RFC 9496 §4.3.1), batched over the trailing axes like every other
+kernel in ops/:
+
+    s      <- field element; fail if non-canonical or negative (odd)
+    ss     = s^2; u1 = 1 - ss; u2 = 1 + ss
+    v      = -(d*u1^2) - u2^2
+    I      = invsqrt(v * u2^2)        (SQRT_RATIO_M1 with numerator 1)
+    x      = |2*s * I*u2|;  y = u1 * I^2 * u2 * v;  t = x*y
+    fail if not was_square, y == 0, or t negative
+
+Decoded points land in the SAME extended (X, Y, Z=1, T) coordinates the
+ed25519 kernels use, so they feed the shared Pippenger MSM (ops/msm_jax.py)
+directly. Ristretto's quotient-group equality (encode(P) == encode(Q) iff
+P - Q is small torsion) is handled by the RLC layer: every lane coefficient
+is a multiple of 8, which annihilates the torsion component exactly
+(crypto/batch.py).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tendermint_tpu.ops import fe25519 as fe
+from tendermint_tpu.ops.ed25519_jax import FieldCtx, Point, make_ctx
+
+
+def _sqrt_ratio_1v(ctx: FieldCtx, v: jnp.ndarray):
+    """SQRT_RATIO_M1(1, v): returns (was_square, r) with r = nonneg
+    sqrt(1/v) when v is square, sqrt(sqrt_m1/v) otherwise; r = 0 for v = 0."""
+    v3 = fe.mul(fe.square(v), v)
+    v7 = fe.mul(fe.square(v3), v)
+    r = fe.mul(v3, fe.pow_p58(v7))
+    check = fe.mul(v, fe.square(r))
+    one = ctx.one
+    neg_one = ctx.neg(one)
+    correct = fe.eq(check, one)
+    flipped = fe.eq(check, neg_one)
+    flipped_i = fe.eq(check, ctx.neg(ctx.sqrt_m1))
+    r = fe.select(flipped | flipped_i, fe.mul(r, ctx.sqrt_m1), r)
+    # nonnegative representative
+    r = fe.freeze(r)
+    r = fe.select(fe.bit(r, 0) == 1, ctx.neg(r), r)
+    return correct | flipped, r
+
+
+def ristretto_decode(ctx: FieldCtx, s_bytes: jnp.ndarray) -> Tuple[Point, jnp.ndarray]:
+    """uint8[32, ...batch] -> (Point, ok mask). Invalid lanes return junk
+    coordinates under ok=False (callers select the identity)."""
+    s_bytes = jnp.asarray(s_bytes)
+    high_bit = (s_bytes[31] >> 7) & 1
+    s = fe.from_bytes(s_bytes, mask_high_bit=True)
+    # canonical (< p), top bit clear, and nonnegative (even)
+    ok = fe.is_canonical_bytes(s_bytes) & (high_bit == 0) & ((s_bytes[0] & 1) == 0)
+
+    one = ctx.one
+    ss = fe.square(s)
+    u1 = ctx.sub(one, ss)
+    u2 = fe.add(one, ss)
+    u2_sqr = fe.square(u2)
+    v = ctx.sub(ctx.neg(fe.mul(ctx.d, fe.square(u1))), u2_sqr)
+    was_square, invsqrt = _sqrt_ratio_1v(ctx, fe.mul(v, u2_sqr))
+    den_x = fe.mul(invsqrt, u2)
+    den_y = fe.mul(fe.mul(invsqrt, den_x), v)
+    x = fe.freeze(fe.mul(fe.mul_small(s, 2), den_x))
+    x = fe.select(fe.bit(x, 0) == 1, ctx.neg(x), x)  # CT_ABS
+    y = fe.mul(u1, den_y)
+    t = fe.mul(x, y)
+    t_frozen = fe.freeze(t)
+    ok = ok & was_square & ~fe.is_zero(y) & (fe.bit(t_frozen, 0) == 0)
+    return Point(x, y, one, t), ok
+
+
+_decode_jit = jax.jit(ristretto_decode)
+
+
+def decode_rows(rows) -> Tuple[Tuple, "jnp.ndarray"]:
+    """rows (m, 32) uint8 -> ((x, y, z, t) each (20, m) int32, ok (m,) bool).
+    Host helper mirroring msm_jax.decompress_rows, used to fill the pubkey
+    cache with predecoded sr25519 validator keys."""
+    import numpy as np
+
+    m = rows.shape[0]
+    pad = 1 << max(6, (m - 1).bit_length())
+    buf = np.zeros((pad, 32), dtype=np.uint8)
+    buf[:, 0] = 1  # odd -> invalid, but masked by slicing below
+    buf[:m] = rows
+    p, ok = _decode_jit(make_ctx((pad,)), np.ascontiguousarray(buf.T))
+    coords = tuple(np.asarray(c)[:, :m] for c in p)
+    return coords, np.asarray(ok)[:m]

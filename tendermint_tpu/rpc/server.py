@@ -82,6 +82,8 @@ class RPCServer:
             "dial_peers": self._dial_peers,
             "dial_seeds": self._dial_seeds,
             "unsafe_flush_mempool": self._unsafe_flush_mempool,
+            "unsafe_dump_stacks": self._unsafe_dump_stacks,
+            "unsafe_dump_heap": self._unsafe_dump_heap,
         }
 
     async def start(self) -> None:
@@ -324,15 +326,21 @@ class RPCServer:
                 prove=bool(params.get("prove", False)),
             )
         )
-        return {
-            "response": {
-                "code": res.code,
-                "log": res.log,
-                "key": _b64(res.key),
-                "value": _b64(res.value),
-                "height": str(res.height),
-            }
+        out = {
+            "code": res.code,
+            "log": res.log,
+            "key": _b64(res.key),
+            "value": _b64(res.value),
+            "height": str(res.height),
         }
+        if res.proof_ops:
+            out["proofOps"] = {
+                "ops": [
+                    {"type": op.type, "key": _b64(op.key), "data": _b64(op.data)}
+                    for op in res.proof_ops
+                ]
+            }
+        return {"response": out}
 
     async def _abci_info(self, params) -> dict:
         res = self.node.proxy_app.query.info(abci.RequestInfo())
@@ -653,6 +661,56 @@ class RPCServer:
         self._require_unsafe()
         self.node.mempool.flush()
         return {}
+
+    async def _unsafe_dump_stacks(self, params) -> dict:
+        """Stack profile: every thread's Python stack plus every asyncio
+        task's coroutine stack — the goroutine-profile analog the reference
+        debug dump captures (cmd/tendermint/commands/debug/dump.go:117
+        dumpProfile("goroutine"))."""
+        self._require_unsafe()
+        import sys
+        import traceback
+
+        threads = {}
+        for tid, frame in sys._current_frames().items():
+            threads[str(tid)] = "".join(traceback.format_stack(frame))
+        tasks = {}
+        for i, task in enumerate(asyncio.all_tasks()):
+            stack = task.get_stack(limit=16)
+            tasks[f"{i}:{task.get_name()}"] = "".join(
+                "".join(traceback.format_stack(f)) for f in stack
+            ) or repr(task)
+        return {"threads": threads, "tasks": tasks}
+
+    async def _unsafe_dump_heap(self, params) -> dict:
+        """Heap profile via tracemalloc — the heap-pprof analog
+        (cmd/tendermint/commands/debug/dump.go:121 dumpProfile("heap")).
+        First call starts tracing and returns a baseline marker; subsequent
+        calls return the top allocation sites."""
+        self._require_unsafe()
+        import tracemalloc
+
+        top_n = int(params.get("top", 50))
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+            return {"tracing_started": True, "top": []}
+        snap = tracemalloc.take_snapshot()
+        stats = snap.statistics("lineno")[:top_n]
+        cur, peak = tracemalloc.get_traced_memory()
+        return {
+            "tracing_started": False,
+            "traced_current_bytes": cur,
+            "traced_peak_bytes": peak,
+            "top": [
+                {
+                    "file": str(s.traceback[0].filename),
+                    "line": s.traceback[0].lineno,
+                    "size_bytes": s.size,
+                    "count": s.count,
+                }
+                for s in stats
+            ],
+        }
 
     async def _dial_peers(self, params) -> dict:
         """unsafe route (reference: rpc/core/net.go UnsafeDialPeers)."""

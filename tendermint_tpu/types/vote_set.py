@@ -142,9 +142,12 @@ class VoteSet:
             return bv.get_by_index(idx)
         return None
 
-    def add_vote(self, vote: Vote) -> bool:
-        """Returns True if the vote was newly added. Raises VoteSetError on
-        invalid votes and ConflictingVotesError on equivocation
+    def add_vote(self, vote: Vote):
+        """Returns a truthy value if the vote was newly accepted: True when
+        verified-and-committed, the string "pending" when queued for
+        deferred batch verification (NOT yet verified — callers must not
+        gossip/advertise it until flush() commits it). Raises VoteSetError
+        on invalid votes and ConflictingVotesError on equivocation
         (reference: types/vote_set.go:143-290)."""
         if vote is None:
             raise VoteSetError("nil vote")
@@ -181,7 +184,7 @@ class VoteSet:
                 return False
             self._pending_seen.add(seen_key)
             self._pending.append((idx, vote))
-            return True
+            return "pending"
 
         if not self._verify_now(vote, val.pub_key):
             raise VoteSetError(f"invalid signature from validator {idx}")
@@ -193,13 +196,14 @@ class VoteSet:
     def _verify_now(self, vote: Vote, pub_key) -> bool:
         return pub_key.verify(vote.sign_bytes(self.chain_id), vote.signature)
 
-    def flush(self) -> List[int]:
+    def flush(self) -> Tuple[List[Vote], List[int]]:
         """Batch-verify all deferred votes in one device call; commits the
         valid ones through the same conflict-aware path as add_vote. Returns
-        indices of votes that FAILED verification; conflicts discovered are
-        available via pop_conflicts()."""
+        (committed votes — safe to publish/gossip now, indices of votes that
+        FAILED verification); conflicts discovered are available via
+        pop_conflicts()."""
         if not self._pending:
-            return []
+            return [], []
         pubkeys, msgs, sigs = [], [], []
         for idx, vote in self._pending:
             _, val = self.val_set.get_by_index(idx)
@@ -207,6 +211,7 @@ class VoteSet:
             msgs.append(vote.sign_bytes(self.chain_id))
             sigs.append(vote.signature)
         mask = verify_batch(pubkeys, msgs, sigs)
+        committed = []
         failed = []
         for ok, (idx, vote) in zip(mask, self._pending):
             if not ok:
@@ -216,12 +221,14 @@ class VoteSet:
             # Re-check: an earlier pending vote may have committed already.
             if self._get_vote(idx, vote.block_id.key()) is not None:
                 continue
-            _, conflicting = self._add_verified(idx, vote, val.voting_power)
+            added, conflicting = self._add_verified(idx, vote, val.voting_power)
+            if added:
+                committed.append(vote)
             if conflicting is not None:
                 self._conflicts.append(ConflictingVotesError(conflicting, vote))
         self._pending.clear()
         self._pending_seen.clear()
-        return failed
+        return committed, failed
 
     def _add_verified(
         self, idx: int, vote: Vote, power: int

@@ -24,10 +24,17 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-# The env var alone is NOT enough: an injected sitecustomize (axon tooling)
-# registers the TPU platform and overrides JAX_PLATFORMS at interpreter
-# start, so tests silently ran against the TPU tunnel (slow remote compiles,
-# concurrent-compile flakes). jax.config.update wins over both — force it.
+# The env vars alone are NOT enough: an injected sitecustomize (axon tooling)
+# imports jax at interpreter start — before this file runs — so jax has
+# already read its config env vars (tests silently ran against the TPU
+# tunnel, and the persistent-cache vars were ignored, leaving .jax_cache
+# empty and every run cold-compiling for ~40 minutes). jax.config.update
+# works post-import — force all of it.
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".jax_cache")),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

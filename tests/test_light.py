@@ -308,7 +308,7 @@ def test_light_proxy_serves_verified_routes(tmp_path):
 
     import aiohttp
 
-    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.abci.kvstore import MerkleKVStoreApplication
     from tendermint_tpu.config.config import test_config
     from tendermint_tpu.light.proxy import LightProxy
     from tendermint_tpu.node.node import Node
@@ -325,11 +325,12 @@ def test_light_proxy_serves_verified_routes(tmp_path):
         priv = FilePV(gen_ed25519(b"\x93" * 32))
         gen = GenesisDoc(chain_id="lp-chain",
                          validators=[GenesisValidator(priv.get_pub_key(), 10)])
-        node = Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
+        node = Node(cfg, gen, priv_validator=priv, app=MerkleKVStoreApplication())
         await node.start()
         backend = HTTPClient(f"http://127.0.0.1:{port}")
         proxy = None
         try:
+            node.mempool.check_tx(b"lpk=lpv")
             await node.wait_for_height(5, timeout=60)
             from tendermint_tpu.light import Client as LClient, HTTPProvider, LightStore, TrustOptions
 
@@ -367,6 +368,22 @@ def test_light_proxy_serves_verified_routes(tmp_path):
                 # unverified forwarding is marked
                 ab = await call("abci_info")
                 assert ab["light_client_verified"] is False
+
+                # abci_query: merkle proof verified against the header's
+                # app_hash (light/rpc/client.go:116)
+                import base64 as b64mod
+
+                aq = await call("abci_query", data=b"lpk".hex())
+                assert aq["light_client_verified"] is True
+                assert b64mod.b64decode(aq["response"]["value"]) == b"lpv"
+
+                # a missing key has no ValueOp absence proof -> error
+                async with sess.post(f"http://{proxy.addr}/", json={
+                    "jsonrpc": "2.0", "id": 2, "method": "abci_query",
+                    "params": {"data": b"nosuchkey".hex()},
+                }) as resp:
+                    body = await resp.json()
+                    assert "error" in body
         finally:
             if proxy is not None:
                 await proxy.stop()

@@ -62,6 +62,7 @@ def test_node_metrics_populated_and_served(tmp_path):
         cfg.rpc.laddr = f"tcp://127.0.0.1:{port}"
         cfg.consensus.wal_path = str(tmp_path / "wal")
         cfg.instrumentation.prometheus = True
+        cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
         priv = FilePV(gen_ed25519(b"\x51" * 32))
         gen = GenesisDoc(chain_id="metrics-chain",
                          validators=[GenesisValidator(priv.get_pub_key(), 10)])
@@ -79,13 +80,23 @@ def test_node_metrics_populated_and_served(tmp_path):
             assert "tendermint_consensus_validators 1" in text
             assert "tendermint_state_block_processing_time_count" in text
 
-            # HTTP exposition
+            # HTTP exposition via the RPC alias route
             async with aiohttp.ClientSession() as sess:
                 async with sess.get(f"http://127.0.0.1:{port}/metrics") as resp:
                     assert resp.status == 200
                     body = await resp.text()
                     assert "tendermint_consensus_height" in body
                     assert "tendermint_mempool_size" in body
+
+            # the DEDICATED prometheus listener (reference: node/node.go:1105
+            # startPrometheusServer on instrumentation.prometheus_listen_addr)
+            assert node.prometheus_server is not None
+            pport = node.prometheus_server.port
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(f"http://127.0.0.1:{pport}/metrics") as resp:
+                    assert resp.status == 200
+                    body = await resp.text()
+                    assert "tendermint_consensus_height" in body
         finally:
             await node.stop()
 

@@ -49,7 +49,7 @@ def test_rlc_all_valid_and_cached_path(rlc_on):
     # first call: uncached kernel; fills the pubkey cache
     mask = B.verify_batch_jax(pubkeys, msgs, sigs)
     assert mask.all()
-    assert all(bytes(pk) in B._A_CACHE for pk in pubkeys)
+    assert all(B._cache_key(bytes(pk), "ed25519") in B._A_CACHE for pk in pubkeys)
     # second call: cached-A kernel; same verdict
     mask2 = B.verify_batch_jax(pubkeys, msgs, sigs)
     assert mask2.all()
@@ -103,3 +103,68 @@ def test_rlc_matches_cpu_backend_on_mixed_validity(rlc_on):
     got = B.verify_batch_jax(pubkeys, msgs, sigs)
     want = B.verify_batch_cpu(pubkeys, msgs, sigs)
     assert (got == want).all()
+
+
+def make_mixed_batch(n, n_sr, seed=0, msg_len=40):
+    """Interleaved ed25519/sr25519 rows (sr rows scattered, not a suffix)."""
+    from tendermint_tpu.crypto.sr25519 import gen_sr25519
+
+    pubkeys, msgs, sigs, types = [], [], [], []
+    for i in range(n):
+        sd = bytes([seed]) * 30 + bytes([i // 256, i % 256])
+        msg = b"mix-%03d-" % i + b"y" * (msg_len - 8)
+        if i % max(n // max(n_sr, 1), 1) == 1 and sum(
+            1 for t in types if t == "sr25519"
+        ) < n_sr:
+            priv = gen_sr25519(sd)
+            types.append("sr25519")
+        else:
+            priv = gen_ed25519(sd)
+            types.append("ed25519")
+        pubkeys.append(priv.pub_key().bytes())
+        msgs.append(msg)
+        sigs.append(priv.sign(msg))
+    return pubkeys, msgs, sigs, types
+
+
+def test_rlc_mixed_all_valid_device_path(rlc_on):
+    pubkeys, msgs, sigs, types = make_mixed_batch(40, 10)
+    mask = B.verify_batch(pubkeys, msgs, sigs, backend="jax", key_types=types)
+    assert mask.all()
+    assert B.LAST_JAX_PATH[0] == "rlc-mixed"
+    assert B.LAST_RLC_TIMINGS.get("mode") == "mixed"
+    # sr keys landed in the typed cache
+    for pk, t in zip(pubkeys, types):
+        assert B._cache_key(bytes(pk), t) in B._A_CACHE
+
+
+def test_rlc_mixed_bad_rows_fall_back_to_exact_mask(rlc_on):
+    pubkeys, msgs, sigs, types = make_mixed_batch(40, 10, seed=3)
+    sr_rows = [i for i, t in enumerate(types) if t == "sr25519"]
+    ed_rows = [i for i, t in enumerate(types) if t == "ed25519"]
+    bad_sr, bad_ed = sr_rows[2], ed_rows[5]
+    sigs[bad_sr] = sigs[bad_sr][:33] + bytes([sigs[bad_sr][33] ^ 1]) + sigs[bad_sr][34:]
+    msgs[bad_ed] = b"tampered" + msgs[bad_ed][8:]
+    mask = B.verify_batch(pubkeys, msgs, sigs, backend="jax", key_types=types)
+    expected = np.ones(40, dtype=bool)
+    expected[bad_sr] = expected[bad_ed] = False
+    assert (mask == expected).all()
+
+
+def test_rlc_mixed_matches_host_verifiers(rlc_on):
+    from tendermint_tpu.crypto.keys import Ed25519PubKey
+    from tendermint_tpu.crypto.sr25519 import sr25519_verify
+
+    pubkeys, msgs, sigs, types = make_mixed_batch(32, 8, seed=5)
+    # corrupt: sr sig without marker bit, ed invalid pubkey, swapped messages
+    sr_rows = [i for i, t in enumerate(types) if t == "sr25519"]
+    i0 = sr_rows[0]
+    sigs[i0] = sigs[i0][:63] + bytes([sigs[i0][63] & 0x7F])  # clear marker
+    msgs[2], msgs[3] = msgs[3], msgs[2]
+    got = B.verify_batch(pubkeys, msgs, sigs, backend="jax", key_types=types)
+    for i in range(32):
+        if types[i] == "ed25519":
+            want = Ed25519PubKey(bytes(pubkeys[i])).verify(bytes(msgs[i]), bytes(sigs[i]))
+        else:
+            want = sr25519_verify(bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i]))
+        assert got[i] == want, (i, types[i])

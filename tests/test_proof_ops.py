@@ -1,0 +1,106 @@
+"""Merkle proof operators (crypto/proof_ops.py) — the generalized proof
+framework behind light-client-verified abci_query
+(reference: crypto/merkle/proof_op.go, proof_value.go, proof_key_path.go)."""
+
+import pytest
+
+from tendermint_tpu.crypto.proof_ops import (
+    KEY_ENCODING_HEX,
+    KEY_ENCODING_URL,
+    KeyPath,
+    ProofOp,
+    ValueOp,
+    decode_proof_ops,
+    default_proof_runtime,
+    encode_proof_ops,
+    key_path_to_keys,
+    simple_map_proofs,
+)
+
+
+def test_key_path_roundtrip():
+    kp = KeyPath()
+    kp.append_key(b"App", KEY_ENCODING_URL)
+    kp.append_key(b"IBC", KEY_ENCODING_URL)
+    kp.append_key(b"\x01\x02\x03", KEY_ENCODING_HEX)
+    s = str(kp)
+    assert s == "/App/IBC/x:010203"
+    assert key_path_to_keys(s) == [b"App", b"IBC", b"\x01\x02\x03"]
+    # url-encoding survives awkward bytes
+    kp2 = KeyPath().append_key(b"a/b c%", KEY_ENCODING_URL)
+    assert key_path_to_keys(str(kp2)) == [b"a/b c%"]
+    with pytest.raises(ValueError):
+        key_path_to_keys("no-leading-slash")
+
+
+def test_value_op_verifies_and_rejects_tampering():
+    kv = {b"k%d" % i: b"v%d" % i for i in range(7)}
+    root, ops = simple_map_proofs(kv)
+    prt = default_proof_runtime()
+
+    pop = ops[b"k3"].proof_op()
+    kp = str(KeyPath().append_key(b"k3"))
+    prt.verify_value([pop], root, kp, b"v3")  # ok
+
+    with pytest.raises(ValueError):  # wrong value
+        prt.verify_value([pop], root, kp, b"v4")
+    with pytest.raises(ValueError):  # wrong root
+        prt.verify_value([pop], b"\x00" * 32, kp, b"v3")
+    with pytest.raises(ValueError):  # wrong key in path
+        prt.verify_value([pop], root, str(KeyPath().append_key(b"k4")), b"v3")
+    with pytest.raises(ValueError):  # leftover keypath segments
+        prt.verify_value(
+            [pop], root, str(KeyPath().append_key(b"extra").append_key(b"k3")), b"v3"
+        )
+
+
+def test_proof_op_wire_roundtrip():
+    kv = {b"alpha": b"1", b"beta": b"2"}
+    root, ops = simple_map_proofs(kv)
+    pop = ops[b"beta"].proof_op()
+    raw = encode_proof_ops([pop])
+    back = decode_proof_ops(raw)
+    assert len(back) == 1
+    assert back[0].type == pop.type and back[0].key == pop.key
+    vop = ValueOp.from_proof_op(back[0])
+    assert vop.run([b"2"])[0] == root
+
+
+def test_two_layer_op_chain():
+    """Substore root proven inside an outer map — the multi-op path the
+    runtime walks right-to-left (proof_op.go:39)."""
+    inner = {b"x": b"42"}
+    inner_root, inner_ops = simple_map_proofs(inner)
+    outer = {b"store": inner_root, b"other": b"zzz"}
+    outer_root, outer_ops = simple_map_proofs(outer)
+
+    pops = [inner_ops[b"x"].proof_op(), outer_ops[b"store"].proof_op()]
+    kp = KeyPath().append_key(b"store").append_key(b"x")
+    default_proof_runtime().verify_value(pops, outer_root, str(kp), b"42")
+
+    with pytest.raises(ValueError):
+        default_proof_runtime().verify_value(pops, outer_root, str(kp), b"43")
+
+
+def test_merkle_kvstore_app_proofs():
+    """MerkleKVStoreApplication: app_hash == simple-map root; prove=true
+    queries carry a ValueOp that verifies against it."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.kvstore import MerkleKVStoreApplication
+
+    app = MerkleKVStoreApplication()
+    app.deliver_tx(abci.RequestDeliverTx(tx=b"name=tpu"))
+    app.deliver_tx(abci.RequestDeliverTx(tx=b"lang=py"))
+    res_commit = app.commit()
+    root = res_commit.data
+    assert root == app.app_hash and len(root) == 32
+
+    res = app.query(abci.RequestQuery(data=b"name", prove=True))
+    assert res.value == b"tpu"
+    assert res.proof_ops and len(res.proof_ops) == 1
+    prt = default_proof_runtime()
+    prt.verify_value(res.proof_ops, root, str(KeyPath().append_key(b"name")), b"tpu")
+
+    # unproven query has no ops
+    res2 = app.query(abci.RequestQuery(data=b"name"))
+    assert res2.proof_ops is None

@@ -1,0 +1,50 @@
+"""Batched ristretto255 decode (ops/ristretto_jax.py) — differential vs the
+host implementation (crypto/sr25519.py), including invalid and edge
+encodings. Reference semantics: crypto/sr25519/pubkey.go:34 (go-schnorrkel
+-> ristretto255 decode)."""
+
+import numpy as np
+
+from tendermint_tpu.crypto.ed25519_ref import BASE, P, point_mul
+from tendermint_tpu.crypto.sr25519 import ristretto_decode as host_decode
+from tendermint_tpu.crypto.sr25519 import ristretto_encode
+from tendermint_tpu.ops import fe25519 as fe
+from tendermint_tpu.ops.ristretto_jax import decode_rows
+
+
+def _limbs_to_int(l):
+    v = 0
+    for i in range(fe.NLIMBS - 1, -1, -1):
+        v = (v << fe.RADIX) + int(l[i])
+    return v % P
+
+
+def test_decode_matches_host():
+    rng = np.random.default_rng(7)
+    rows, expect = [], []
+    for _ in range(20):  # valid: random multiples of the basepoint
+        enc = ristretto_encode(point_mul(int(rng.integers(1, 1 << 60)), BASE))
+        rows.append(np.frombuffer(enc, dtype=np.uint8))
+        expect.append(host_decode(enc))
+    for b in [
+        b"\x01" + b"\x00" * 31,  # negative (odd) s
+        b"\xff" * 32,  # non-canonical, high bit set
+        bytes(32),  # identity encoding (valid)
+        (P - 1).to_bytes(32, "little"),  # canonical field element, not a point
+        P.to_bytes(32, "little"),  # non-canonical (== p)
+        (2).to_bytes(32, "little"),
+    ]:
+        rows.append(np.frombuffer(b, dtype=np.uint8))
+        expect.append(host_decode(b))
+    coords, ok = decode_rows(np.stack(rows))
+    for j, e in enumerate(expect):
+        if e is None:
+            assert not ok[j], f"lane {j} should be invalid"
+            continue
+        assert ok[j], f"lane {j} should be valid"
+        x, y, z, t = (_limbs_to_int(coords[c][:, j]) for c in range(4))
+        zinv = pow(z, P - 2, P)
+        ex = e[0] * pow(e[2], P - 2, P) % P
+        ey = e[1] * pow(e[2], P - 2, P) % P
+        assert (x * zinv % P, y * zinv % P) == (ex, ey), f"lane {j} affine mismatch"
+        assert t * zinv % P == (x * zinv % P) * (y * zinv % P) % P, f"lane {j} T"

@@ -217,3 +217,40 @@ def test_unsafe_routes_gated_and_mempool_wal(tmp_path):
         assert txs == [b"tx-one", b"tx-two"]
 
     asyncio.run(run())
+
+
+def test_unsafe_profile_dump_routes(tmp_path):
+    """unsafe_dump_stacks / unsafe_dump_heap: the debug dump's pprof analogs
+    (reference: cmd/tendermint/commands/debug/dump.go:117-125)."""
+
+    async def run():
+        node = make_node(tmp_path)
+        await node.start()
+        try:
+            client = LocalClient(node)
+            try:
+                await client.call("unsafe_dump_stacks")
+                assert False, "should be gated"
+            except Exception as e:
+                assert "unsafe" in str(e)
+            node.config.rpc.unsafe = True
+
+            stacks = await client.call("unsafe_dump_stacks")
+            assert stacks["threads"]  # at least the main thread
+            assert stacks["tasks"]  # consensus receive loop etc.
+            assert any("cs_state" in s or "receive" in s for s in stacks["tasks"].values())
+
+            first = await client.call("unsafe_dump_heap")
+            assert first["tracing_started"] is True
+            second = await client.call("unsafe_dump_heap", top=10)
+            assert second["tracing_started"] is False
+            assert second["traced_current_bytes"] > 0
+            assert len(second["top"]) <= 10
+            assert all("file" in s and "size_bytes" in s for s in second["top"])
+            import tracemalloc
+
+            tracemalloc.stop()
+        finally:
+            await node.stop()
+
+    asyncio.run(run())

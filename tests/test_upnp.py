@@ -1,0 +1,166 @@
+"""UPnP IGD client (p2p/upnp.py) against a loopback fake gateway
+(reference: p2p/upnp/upnp.go, probe.go). The fake answers SSDP M-SEARCH on a
+unicast UDP port, serves a device description, and implements the three
+WANIPConnection SOAP actions."""
+
+import asyncio
+import socket
+
+import pytest
+from aiohttp import web
+
+from tendermint_tpu.p2p.upnp import NAT, UPNPError, discover, probe
+
+DESCRIPTION = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+  <device>
+    <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+    <deviceList><device>
+      <deviceType>urn:schemas-upnp-org:device:WANDevice:1</deviceType>
+      <deviceList><device>
+        <deviceType>urn:schemas-upnp-org:device:WANConnectionDevice:1</deviceType>
+        <serviceList><service>
+          <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+          <controlURL>/ctl/IPConn</controlURL>
+        </service></serviceList>
+      </device></deviceList>
+    </device></deviceList>
+  </device>
+</root>"""
+
+
+class FakeIGD:
+    """Loopback IGD: unicast SSDP responder + HTTP description/SOAP."""
+
+    def __init__(self):
+        self.mappings = {}
+        self.runner = None
+        self.http_port = 0
+        self.ssdp_port = 0
+        self._ssdp_sock = None
+        self._ssdp_task = None
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get("/igd.xml", self._desc)
+        app.router.add_post("/ctl/IPConn", self._soap)
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        self.http_port = site._server.sockets[0].getsockname()[1]
+
+        self._ssdp_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._ssdp_sock.setblocking(False)
+        self._ssdp_sock.bind(("127.0.0.1", 0))
+        self.ssdp_port = self._ssdp_sock.getsockname()[1]
+        self._ssdp_task = asyncio.create_task(self._ssdp_loop())
+
+    async def stop(self):
+        if self._ssdp_task:
+            self._ssdp_task.cancel()
+        if self._ssdp_sock:
+            self._ssdp_sock.close()
+        if self.runner:
+            await self.runner.cleanup()
+
+    async def _ssdp_loop(self):
+        loop = asyncio.get_running_loop()
+        while True:
+            data, addr = await loop.sock_recvfrom(self._ssdp_sock, 4096)
+            if b"M-SEARCH" not in data:
+                continue
+            resp = (
+                "HTTP/1.1 200 OK\r\n"
+                "ST: urn:schemas-upnp-org:device:InternetGatewayDevice:1\r\n"
+                f"LOCATION: http://127.0.0.1:{self.http_port}/igd.xml\r\n"
+                "\r\n"
+            ).encode()
+            await loop.sock_sendto(self._ssdp_sock, resp, addr)
+
+    async def _desc(self, request):
+        return web.Response(text=DESCRIPTION, content_type="text/xml")
+
+    async def _soap(self, request):
+        body = await request.text()
+        action = request.headers.get("SOAPAction", "")
+
+        def ok(inner=""):
+            return web.Response(
+                text=(
+                    "<?xml version=\"1.0\"?><s:Envelope "
+                    "xmlns:s=\"http://schemas.xmlsoap.org/soap/envelope/\">"
+                    f"<s:Body>{inner}</s:Body></s:Envelope>"
+                ),
+                content_type="text/xml",
+            )
+
+        if "GetExternalIPAddress" in action:
+            return ok(
+                "<GetExternalIPAddressResponse>"
+                "<NewExternalIPAddress>203.0.113.7</NewExternalIPAddress>"
+                "</GetExternalIPAddressResponse>"
+            )
+        if "AddPortMapping" in action:
+            import re
+
+            port = int(re.search(r"<NewExternalPort>(\d+)<", body).group(1))
+            proto = re.search(r"<NewProtocol>(\w+)<", body).group(1)
+            self.mappings[(proto, port)] = body
+            return ok("<AddPortMappingResponse/>")
+        if "DeletePortMapping" in action:
+            import re
+
+            port = int(re.search(r"<NewExternalPort>(\d+)<", body).group(1))
+            proto = re.search(r"<NewProtocol>(\w+)<", body).group(1)
+            if (proto, port) not in self.mappings:
+                return web.Response(status=500, text="no such mapping")
+            del self.mappings[(proto, port)]
+            return ok("<DeletePortMappingResponse/>")
+        return web.Response(status=500, text="unknown action")
+
+
+def test_discover_map_unmap_and_probe():
+    async def go():
+        igd = FakeIGD()
+        await igd.start()
+        try:
+            nat = await discover(
+                timeout=3.0, ssdp_addr="127.0.0.1", ssdp_port=igd.ssdp_port
+            )
+            assert nat.control_url.endswith("/ctl/IPConn")
+            assert await nat.get_external_address() == "203.0.113.7"
+
+            await nat.add_port_mapping("tcp", 26656, 26656, "127.0.0.1", "tm", 0)
+            assert ("TCP", 26656) in igd.mappings
+            await nat.delete_port_mapping("tcp", 26656)
+            assert not igd.mappings
+
+            caps = await probe(
+                int_port=26656, ext_port=26656,
+                timeout=3.0, ssdp_addr="127.0.0.1", ssdp_port=igd.ssdp_port,
+            )
+            assert caps == {
+                "upnp": True,
+                "external_ip": "203.0.113.7",
+                "port_mapping": True,
+            }
+        finally:
+            await igd.stop()
+
+    asyncio.run(go())
+
+
+def test_discover_timeout_raises():
+    async def go():
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        silent_port = s.getsockname()[1]
+        # keep the socket open but never answer
+        try:
+            with pytest.raises(UPNPError):
+                await discover(timeout=0.5, ssdp_addr="127.0.0.1", ssdp_port=silent_port)
+        finally:
+            s.close()
+
+    asyncio.run(go())

@@ -285,8 +285,9 @@ def test_vote_set_deferred_batch_flush():
             sig = bytes([sig[0] ^ 1]) + sig[1:]  # corrupt one
         vote_set.add_vote(v.with_signature(sig))
     assert not vote_set.has_two_thirds_majority()  # nothing committed yet
-    failed = vote_set.flush()
+    committed, failed = vote_set.flush()
     assert failed == [2]
+    assert len(committed) == 3  # the valid votes, published only now
     assert vote_set.has_two_thirds_majority()  # 3/4 valid > 2/3
 
 
@@ -306,8 +307,9 @@ def test_vote_set_deferred_detects_equivocation():
     v1, v2 = mk(BID), mk(other)
     assert vote_set.add_vote(v1)
     assert not vote_set.add_vote(v1)  # duplicate detected while pending
-    assert vote_set.add_vote(v2)  # queued; conflict surfaces at flush
-    assert vote_set.flush() == []
+    assert vote_set.add_vote(v2) == "pending"  # queued; conflict surfaces at flush
+    committed, failed = vote_set.flush()
+    assert failed == []
     conflicts = vote_set.pop_conflicts()
     assert len(conflicts) == 1
     assert {conflicts[0].vote_a.block_id, conflicts[0].vote_b.block_id} == {BID, other}
