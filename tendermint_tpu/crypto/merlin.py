@@ -180,3 +180,141 @@ class Transcript:
 
     def clone(self) -> "Transcript":
         return Transcript(b"", _strobe=self.strobe.clone())
+
+
+# ---------------------------------------------------------------------------
+# Batched transcripts: N independent STROBE states advanced in lockstep with
+# numpy (vectorized keccak-f[1600]). Valid when every row runs the SAME
+# operation sequence with the SAME lengths — exactly the sr25519 batch-verify
+# challenge derivation, where per-row data (msg, pk, R) varies but labels and
+# (grouped-by-length) sizes do not. ~100x faster than N Python transcripts.
+# ---------------------------------------------------------------------------
+
+import numpy as _np
+
+
+def keccak_f1600_batch(lanes: "_np.ndarray") -> "_np.ndarray":
+    """lanes: (N, 25) uint64 -> permuted (N, 25); column x + 5*y."""
+
+    def rotl(v, n):
+        if n == 0:
+            return v
+        return (v << _np.uint64(n)) | (v >> _np.uint64(64 - n))
+
+    a = [lanes[:, i].copy() for i in range(25)]
+    for rc in _ROUND_CONSTANTS:
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                a[x + 5 * y] ^= d[x]
+        b = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = rotl(a[x + 5 * y], _ROTC[x][y])
+        for x in range(5):
+            for y in range(5):
+                a[x + 5 * y] = b[x + 5 * y] ^ (~b[(x + 1) % 5 + 5 * y] & b[(x + 2) % 5 + 5 * y])
+        a[0] ^= _np.uint64(rc)
+    return _np.stack(a, axis=1)
+
+
+class BatchStrobe128:
+    """N STROBE-128 states in lockstep (positions/flags shared scalars)."""
+
+    def __init__(self, protocol_label: bytes, n: int):
+        self.n = n
+        self.state = _np.zeros((n, 200), dtype=_np.uint8)
+        init = bytearray(200)
+        init[0:6] = bytes([1, STROBE_R + 2, 1, 0, 1, 96])
+        init[6:18] = b"STROBEv1.0.2"
+        keccak_f1600(init)
+        self.state[:] = _np.frombuffer(bytes(init), dtype=_np.uint8)
+        self.pos = 0
+        self.pos_begin = 0
+        self.cur_flags = 0
+        self.meta_ad(_np.tile(_np.frombuffer(protocol_label, _np.uint8), (n, 1)), False)
+
+    def _run_f(self) -> None:
+        self.state[:, self.pos] ^= self.pos_begin
+        self.state[:, self.pos + 1] ^= 0x04
+        self.state[:, STROBE_R + 1] ^= 0x80
+        lanes = self.state.view(_np.uint64).reshape(self.n, 25)
+        self.state = keccak_f1600_batch(lanes).view(_np.uint8).reshape(self.n, 200).copy()
+        self.pos = 0
+        self.pos_begin = 0
+
+    def _as_rows(self, data) -> "_np.ndarray":
+        """bytes (shared) or (N, L) uint8 array -> (N, L)."""
+        if isinstance(data, (bytes, bytearray)):
+            return _np.tile(_np.frombuffer(bytes(data), _np.uint8), (self.n, 1))
+        return data
+
+    def _absorb(self, data) -> None:
+        rows = self._as_rows(data)
+        off = 0
+        total = rows.shape[1]
+        while off < total:
+            k = min(STROBE_R - self.pos, total - off)
+            self.state[:, self.pos : self.pos + k] ^= rows[:, off : off + k]
+            self.pos += k
+            off += k
+            if self.pos == STROBE_R:
+                self._run_f()
+
+    def _squeeze(self, n_bytes: int) -> "_np.ndarray":
+        out = _np.empty((self.n, n_bytes), dtype=_np.uint8)
+        off = 0
+        while off < n_bytes:
+            k = min(STROBE_R - self.pos, n_bytes - off)
+            out[:, off : off + k] = self.state[:, self.pos : self.pos + k]
+            self.state[:, self.pos : self.pos + k] = 0
+            self.pos += k
+            off += k
+            if self.pos == STROBE_R:
+                self._run_f()
+        return out
+
+    def _begin_op(self, flags: int, more: bool) -> None:
+        if more:
+            if flags != self.cur_flags:
+                raise ValueError("flag mismatch on continuation")
+            return
+        old_begin = self.pos_begin
+        self.pos_begin = self.pos + 1
+        self.cur_flags = flags
+        self._absorb(bytes([old_begin, flags]))
+        if flags & (_FLAG_C | _FLAG_K) and self.pos != 0:
+            self._run_f()
+
+    def meta_ad(self, data, more: bool) -> None:
+        self._begin_op(_FLAG_M | _FLAG_A, more)
+        self._absorb(data)
+
+    def ad(self, data, more: bool) -> None:
+        self._begin_op(_FLAG_A, more)
+        self._absorb(data)
+
+    def prf(self, n_bytes: int, more: bool = False) -> "_np.ndarray":
+        self._begin_op(_FLAG_I | _FLAG_A | _FLAG_C, more)
+        return self._squeeze(n_bytes)
+
+
+class BatchTranscript:
+    """Merlin transcripts in lockstep; per-row payloads must share lengths."""
+
+    def __init__(self, label: bytes, n: int):
+        self.strobe = BatchStrobe128(b"Merlin v1.0", n)
+        self.append_message(b"dom-sep", label)
+
+    def append_message(self, label: bytes, messages) -> None:
+        rows = self.strobe._as_rows(messages)
+        self.strobe.meta_ad(label, False)
+        self.strobe.meta_ad(struct.pack("<I", rows.shape[1]), True)
+        self.strobe.ad(rows, False)
+
+    def challenge_bytes(self, label: bytes, n_bytes: int) -> "_np.ndarray":
+        """-> (N, n_bytes) uint8."""
+        self.strobe.meta_ad(label, False)
+        self.strobe.meta_ad(struct.pack("<I", n_bytes), True)
+        return self.strobe.prf(n_bytes)

@@ -81,7 +81,7 @@ from tendermint_tpu.ops.ed25519_jax import (
 WINDOW_BITS = 8
 NWIN = 32  # 256 bits / 8
 NBUCKETS = 1 << WINDOW_BITS
-FENWICK_K = 16  # max tree levels for N < 2^16 lanes
+FENWICK_K = 17  # max tree levels: boundary prefixes reach N <= 2^16 lanes
 
 
 # --------------------------------------------------------------------------
@@ -215,23 +215,22 @@ def fenwick_node_indices(ends: np.ndarray, n_lanes: int) -> np.ndarray:
 
 
 def sort_windows(digits: np.ndarray):
-    """digits: (n_lanes, NWIN) uint8 — window w digit of lane i is byte w of
+    """digits: (n_lanes, T) uint8 — window w digit of lane i is byte w of
     its scalar. Returns (perm (T, N) int32, node_idx (T, NBUCKETS, K) int32).
     """
-    n = digits.shape[0]
+    n, t = digits.shape
     # per-column stable argsort in ONE call (axis=0), then counts via a
     # single bincount over offset digits
     perm = np.ascontiguousarray(
         np.argsort(digits, axis=0, kind="stable").T.astype(np.int32)
-    )  # (NWIN, n)
-    offs = (np.arange(NWIN, dtype=np.int64) * NBUCKETS)[None, :]
-    flat = digits.astype(np.int64) + offs  # (n, NWIN)
-    counts = np.bincount(flat.ravel(), minlength=NWIN * NBUCKETS).reshape(
-        NWIN, NBUCKETS
-    )
+    )  # (T, n)
+    offs = (np.arange(t, dtype=np.int64) * NBUCKETS)[None, :]
+    flat = digits.astype(np.int64) + offs  # (n, T)
+    counts = np.bincount(flat.ravel(), minlength=t * NBUCKETS).reshape(t, NBUCKETS)
     ends = np.cumsum(counts, axis=1)
     node_idx = fenwick_node_indices(ends, n)
     return perm, node_idx
+
 
 
 def scalars_to_bytes(scalars: Sequence[int], n_lanes: int) -> np.ndarray:
@@ -252,9 +251,6 @@ def scalars_to_bytes(scalars: Sequence[int], n_lanes: int) -> np.ndarray:
 # Device kernel.
 
 
-_TREE_SCAN_WIDTH = 256  # levels at or below this width run in one scan body
-
-
 def _pad_lanes(C: SmallCtx, p: Point, to: int) -> Point:
     w = p.x.shape[-1]
     if w == to:
@@ -272,6 +268,17 @@ def _halve(C: SmallCtx, p: Point) -> Point:
     )
 
 
+_TREE_SCAN_WIDTH = 256  # levels at or below this width run in one scan body
+
+
+def _scan_structures() -> bool:
+    """XLA:CPU's LLVM codegen cannot hold the fully-unrolled point-op
+    graphs (compile memory exhaustion), so the CPU backend keeps the
+    compile-sized scan forms; on TPU the unrolled forms measured ~18%
+    faster end-to-end (loop-iteration overhead on narrow tensors)."""
+    return jax.default_backend() == "cpu"
+
+
 def _tree_levels(C: SmallCtx, p: Point) -> Point:
     """Build the concatenated pair-tree over the last axis, appending one
     identity lane at the end (the Fenwick pad target). p: (20, T, N).
@@ -280,11 +287,13 @@ def _tree_levels(C: SmallCtx, p: Point) -> Point:
     shrinks geometrically, so unrolling is also the work-efficient layout);
     the tail levels run as ONE lax.scan body over fixed (…, 256)-padded
     arrays, so the whole tail costs a single point-add in the compiled
-    graph. Level geometry must match level_widths()/level_offsets()."""
+    graph — a fully-unrolled tree blew past XLA:CPU's compile memory.
+    Level geometry must match level_widths()/level_offsets()."""
     widths = level_widths(p.x.shape[-1])
     levels = [p]
     cur = p
-    while cur.x.shape[-1] > _TREE_SCAN_WIDTH:
+    floor = _TREE_SCAN_WIDTH if _scan_structures() else 1
+    while cur.x.shape[-1] > floor:
         w = cur.x.shape[-1]
         if w % 2 == 1:
             cur = _pad_lanes(C, cur, w + 1)
@@ -293,9 +302,8 @@ def _tree_levels(C: SmallCtx, p: Point) -> Point:
 
     n_tail = len(widths) - len(levels)
     if n_tail > 0:
-        # Fixed-width tail: state is the current level padded to 256; each
-        # iteration halves (pad odd→even first via the identity padding
-        # already present) and re-pads to 256. ys collects every produced
+        # Fixed-width tail: state is the current level padded to a power of
+        # two; each iteration halves and re-pads. ys collects every produced
         # level; logical widths come from level_widths().
         w0 = 1 << (max(cur.x.shape[-1] - 1, 1)).bit_length()  # pow2 >= width
         w0 = max(w0, 2)
@@ -307,8 +315,6 @@ def _tree_levels(C: SmallCtx, p: Point) -> Point:
             return tuple(nxt), tuple(nxt)
 
         _, ys = jax.lax.scan(body, state, None, length=n_tail)
-        # ys coords: (n_tail, 20, …, w0); level i (0-based in tail) has
-        # logical width widths[base + i].
         base = len(levels)
         for i in range(n_tail):
             lw = widths[base + i]
@@ -332,7 +338,7 @@ def _gather_lanes(p: Point, perm: jnp.ndarray) -> Point:
 
 
 def _gather_nodes(tree: Point, node_idx: jnp.ndarray) -> Point:
-    """tree coords (20, T, Wtot+1); node_idx (T, NBUCKETS*K) ->
+    """tree coords (20, T, Wtot+1); node_idx (T, NBUCKETS, K) ->
     (20, T, NBUCKETS, K)."""
     t_, flat = node_idx.shape[0], node_idx.shape[1] * node_idx.shape[2]
     idx = node_idx.reshape(1, t_, flat)
@@ -344,23 +350,28 @@ def _gather_nodes(tree: Point, node_idx: jnp.ndarray) -> Point:
 
 
 def _reduce_last_axis(C: SmallCtx, p: Point) -> Point:
-    """Pair-tree sum over the last axis (power-of-two width)."""
+    """Pair-tree sum over the last axis (odd widths identity-padded)."""
     while p.x.shape[-1] > 1:
-        p = _padd(
-            C,
-            Point(*(a[..., 0::2] for a in p)),
-            Point(*(a[..., 1::2] for a in p)),
-        )
+        w = p.x.shape[-1]
+        if w % 2 == 1:
+            p = _pad_lanes(C, p, w + 1)
+        p = _halve(C, p)
     return Point(*(a[..., 0] for a in p))
 
 
-def _sum_last_axis_scan(C: SmallCtx, p: Point) -> Point:
+def _sum_last_axis(C: SmallCtx, p: Point) -> Point:
     """Tree-sum over the last axis (any width) as ONE scan body: state stays
     at a fixed power-of-two width, each iteration halves and re-pads with
-    identity. Work is W·log W lane-adds instead of W, but W here is the
-    256-bucket axis — compile size matters more than the small extra work."""
+    identity (compile-size over the small extra work)."""
     w = p.x.shape[-1]
     if w == 1:
+        return Point(*(a[..., 0] for a in p))
+    if not _scan_structures():
+        while p.x.shape[-1] > 1:
+            wd = p.x.shape[-1]
+            if wd % 2 == 1:
+                p = _pad_lanes(C, p, wd + 1)
+            p = _halve(C, p)
         return Point(*(a[..., 0] for a in p))
     w0 = max(1 << (w - 1).bit_length(), 2)
     state = tuple(_pad_lanes(C, p, w0))
@@ -386,19 +397,30 @@ def _weighted_bucket_sum(C: SmallCtx, prefix: Point) -> Point:
     v_max = prefix.x.shape[-1] - 1  # 255
     p_last = Point(*(a[..., -1] for a in prefix))  # (20, T)
     rest = Point(*(a[..., :-1] for a in prefix))  # v = 0..254
-    s = _sum_last_axis_scan(C, rest)
+    s = _sum_last_axis(C, rest)
 
     # [255] P_255 = [256] P_255 - P_255: 8 doublings + one add of the negation.
-    def dbl_body(st, _):
-        return tuple(_pdbl(C, Point(*st))), None
+    if not _scan_structures():
+        m = p_last
+        for _ in range(v_max.bit_length()):
+            m = _pdbl(C, m)
+    else:
+        def dbl_body(st, _):
+            return tuple(_pdbl(C, Point(*st))), None
 
-    st, _ = jax.lax.scan(dbl_body, tuple(p_last), None, length=v_max.bit_length())
-    m = _padd(C, Point(*st), _pneg(C, p_last))  # [256]P - P = [255]P
+        st, _ = jax.lax.scan(dbl_body, tuple(p_last), None, length=v_max.bit_length())
+        m = Point(*st)
+    m = _padd(C, m, _pneg(C, p_last))  # [256]P - P = [255]P
     return _padd(C, m, _pneg(C, s))
 
 
 def _combine_windows(C: SmallCtx, w_pts: Point) -> Point:
-    """w_pts coords (20, T) with window w weight 256^w. Horner from MSB."""
+    """w_pts coords (20, T) with window w weight 256^w. Horner from MSB.
+
+    The ~248-doubling sequential depth is inherent (it equals the scalar
+    bit-width); restructuring it (unrolled, pairwise-split) measured no
+    faster on TPU and blew up XLA:CPU compile memory, so the compile-sized
+    nested-loop form stays."""
     t_ = w_pts.x.shape[-1]
     acc = Point(*(a[..., t_ - 1] for a in w_pts))  # (20,)
     xs = jnp.stack(
@@ -406,11 +428,19 @@ def _combine_windows(C: SmallCtx, w_pts: Point) -> Point:
     )  # (T-1, 4, 20)
     xs = xs[::-1]  # MSB-first over remaining windows
 
-    def body(acc_coords, wp):
-        def dbl(_, st):
-            return tuple(_pdbl(C, Point(*st)))
+    unroll_dbl = not _scan_structures()
 
-        acc_coords = jax.lax.fori_loop(0, WINDOW_BITS, dbl, acc_coords)
+    def body(acc_coords, wp):
+        if unroll_dbl:
+            p = Point(*acc_coords)
+            for _ in range(WINDOW_BITS):
+                p = _pdbl(C, p)
+            acc_coords = tuple(p)
+        else:
+            def dbl(_, st):
+                return tuple(_pdbl(C, Point(*st)))
+
+            acc_coords = jax.lax.fori_loop(0, WINDOW_BITS, dbl, acc_coords)
         acc = _padd(C, Point(*acc_coords), Point(wp[0], wp[1], wp[2], wp[3]))
         return tuple(acc), None
 
@@ -418,14 +448,23 @@ def _combine_windows(C: SmallCtx, w_pts: Point) -> Point:
     return Point(*acc_coords)
 
 
-def _msm_is_identity(C: SmallCtx, pts: Point, perm, node_idx) -> jnp.ndarray:
-    """pts: decompressed valid points (20, N); perm (T, N);
-    node_idx (T, NBUCKETS, K). Returns scalar bool: MSM == identity."""
+def _window_points(C: SmallCtx, pts: Point, perm, node_idx) -> Point:
+    """One window group: gather lanes, pair-tree, Fenwick prefix extraction,
+    weighted bucket sums. pts (20, N); perm (T, N); returns (20, T)."""
     gathered = _gather_lanes(pts, perm)  # (20, T, N)
     tree = _tree_levels(C, gathered)  # (20, T, Wtot+1)
     nodes = _gather_nodes(tree, node_idx)  # (20, T, 256, K)
     prefix = _reduce_last_axis(C, nodes)  # (20, T, 256)
-    w_pts = _weighted_bucket_sum(C, prefix)  # (20, T)
+    return _weighted_bucket_sum(C, prefix)  # (20, T)
+
+
+def _msm_is_identity(C: SmallCtx, pts: Point, perm, node_idx) -> jnp.ndarray:
+    """pts: decompressed valid points (20, N); perm (T, N). Returns scalar
+    bool: MSM == identity. (A window-split variant — high windows over the
+    A block only, since R-lane coefficients are < 2^128 — was tried and
+    measured 4x SLOWER on TPU: two half-width pipelines lose to one fused
+    full-width one.)"""
+    w_pts = _window_points(C, pts, perm, node_idx)  # (20, T)
     total = _combine_windows(C, w_pts)  # (20,)
     return fe.is_zero(total.x) & fe.eq(total.y, total.z)
 
@@ -436,11 +475,13 @@ def _rlc_core(
     node_idx: jnp.ndarray,  # (T, NBUCKETS, K) int32
     fctx: FieldCtx,  # materialized at batch shape (N,) for decompress
     C: SmallCtx,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (batch_ok scalar bool, lane_ok bool (N,))."""
+) -> jnp.ndarray:
+    """Returns bool (1+N,): [batch_ok, lane_ok...] packed into ONE array so
+    the caller syncs in a single D2H round trip."""
     p, ok = decompress(fctx, pts_bytes)
     p = _pselect(ok, p, identity(fctx))
-    return _msm_is_identity(C, p, perm, node_idx), ok
+    bok = _msm_is_identity(C, p, perm, node_idx)
+    return jnp.concatenate([bok[None], ok])
 
 
 def _rlc_core_cached(
@@ -450,9 +491,9 @@ def _rlc_core_cached(
     node_idx,
     fctx: FieldCtx,  # at shape (Nr,)
     C: SmallCtx,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> jnp.ndarray:
     """Cached-A variant: lanes = [A block | R block]; only R is decompressed.
-    Returns (batch_ok, r_ok (Nr,))."""
+    Returns bool (1+Nr,): [batch_ok, r_ok...]."""
     r, r_ok = decompress(fctx, r_bytes)
     r = _pselect(r_ok, r, identity(fctx))
     pts = Point(
@@ -461,7 +502,8 @@ def _rlc_core_cached(
             for a, b in zip(Point(ax, ay, az, at), r)
         )
     )
-    return _msm_is_identity(C, pts, perm, node_idx), r_ok
+    bok = _msm_is_identity(C, pts, perm, node_idx)
+    return jnp.concatenate([bok[None], r_ok])
 
 
 def _rlc_core_cached_mixed(
@@ -473,9 +515,9 @@ def _rlc_core_cached_mixed(
     fctx_ed: FieldCtx,  # at shape (Ne,)
     fctx_sr: FieldCtx,  # at shape (Ns,)
     C: SmallCtx,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> jnp.ndarray:
     """Mixed-key-type cached-A variant: lanes = [A block | edR | srR].
-    Returns (batch_ok, ed_r_ok (Ne,), sr_r_ok (Ns,))."""
+    Returns bool (1+Ne+Ns,): [batch_ok, ed_r_ok..., sr_r_ok...]."""
     from tendermint_tpu.ops.ristretto_jax import ristretto_decode
 
     er, er_ok = decompress(fctx_ed, ed_r_bytes)
@@ -488,7 +530,8 @@ def _rlc_core_cached_mixed(
             for a, b, c in zip(Point(ax, ay, az, at), er, sr)
         )
     )
-    return _msm_is_identity(C, pts, perm, node_idx), er_ok, sr_ok
+    bok = _msm_is_identity(C, pts, perm, node_idx)
+    return jnp.concatenate([bok[None], er_ok, sr_ok])
 
 
 _rlc_jit = jax.jit(_rlc_core)
@@ -522,8 +565,9 @@ def decompress_rows(rows: np.ndarray) -> Tuple[Tuple[np.ndarray, ...], np.ndarra
 
 def rlc_check_submit(pts_bytes: np.ndarray, scalars: Sequence[int]):
     """Host prep + async device submit: pts_bytes (N, 32) uint8 encodings,
-    scalars N ints < L (0 = excluded lane). Returns unsynced device values
-    (batch_ok, lane_ok[N]) — np.asarray() them to sync."""
+    [A block | R block] with scalars to match (0 = excluded lane; R-block
+    scalars < 2^128). Returns an unsynced device bool (1+N,):
+    [batch_ok, lane_ok...] — np.asarray() it to sync."""
     n = pts_bytes.shape[0]
     digits = scalars_to_bytes(scalars, n)
     perm, node_idx = sort_windows(digits)
@@ -534,8 +578,8 @@ def rlc_check_submit(pts_bytes: np.ndarray, scalars: Sequence[int]):
 
 
 def rlc_check(pts_bytes: np.ndarray, scalars: Sequence[int]) -> Tuple[bool, np.ndarray]:
-    batch_ok, ok = rlc_check_submit(pts_bytes, scalars)
-    return bool(np.asarray(batch_ok)), np.asarray(ok)
+    out = np.asarray(rlc_check_submit(pts_bytes, scalars))
+    return bool(out[0]), out[1:]
 
 
 def rlc_check_cached_submit(
@@ -543,7 +587,8 @@ def rlc_check_cached_submit(
     r_bytes: np.ndarray,  # (Nr, 32)
     scalars: Sequence[int],  # length Na + Nr, A block first
 ):
-    """Cached-A variant of rlc_check_submit (A predecompressed, R by bytes)."""
+    """Cached-A variant of rlc_check_submit (A predecompressed, R by bytes).
+    Returns an unsynced device bool (1+Nr,): [batch_ok, r_ok...]."""
     na = a_coords[0].shape[-1]
     nr = r_bytes.shape[0]
     n = na + nr
@@ -565,8 +610,8 @@ def rlc_check_cached(
     r_bytes: np.ndarray,
     scalars: Sequence[int],
 ) -> Tuple[bool, np.ndarray]:
-    batch_ok, r_ok = rlc_check_cached_submit(a_coords, r_bytes, scalars)
-    return bool(np.asarray(batch_ok)), np.asarray(r_ok)
+    out = np.asarray(rlc_check_cached_submit(a_coords, r_bytes, scalars))
+    return bool(out[0]), out[1:]
 
 
 def rlc_check_cached_mixed_submit(
@@ -575,8 +620,8 @@ def rlc_check_cached_mixed_submit(
     sr_r_bytes: np.ndarray,  # (Ns, 32)
     scalars: Sequence[int],  # length Na + Ne + Ns: A block, ed R, sr R
 ):
-    """Mixed ed25519+sr25519 cached-A RLC submit (no sync). Returns unsynced
-    (batch_ok, ed_r_ok, sr_r_ok)."""
+    """Mixed ed25519+sr25519 cached-A RLC submit (no sync). Returns an
+    unsynced device bool (1+Ne+Ns,): [batch_ok, ed_r_ok..., sr_r_ok...]."""
     na = a_coords[0].shape[-1]
     ne = ed_r_bytes.shape[0]
     ns = sr_r_bytes.shape[0]

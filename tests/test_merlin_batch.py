@@ -1,0 +1,82 @@
+"""Batched merlin transcripts (crypto/merlin.py BatchTranscript) —
+differential vs the scalar Transcript, including rate-boundary crossing and
+the exact sr25519 challenge derivation used by crypto/batch.py."""
+
+import numpy as np
+
+from tendermint_tpu.crypto.merlin import BatchTranscript, Transcript
+
+
+def _rows(items):
+    return np.stack([np.frombuffer(b, np.uint8) for b in items])
+
+
+def test_batch_matches_scalar_challenges():
+    rng = np.random.default_rng(5)
+    n = 9
+    msgs = [bytes(rng.integers(0, 256, 110, dtype=np.uint8)) for _ in range(n)]
+    pks = [bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(n)]
+    rs = [bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(n)]
+
+    bt = BatchTranscript(b"SigningContext", n)
+    bt.append_message(b"", b"substrate")
+    bt.append_message(b"sign-bytes", _rows(msgs))
+    bt.append_message(b"proto-name", b"Schnorr-sig")
+    bt.append_message(b"sign:pk", _rows(pks))
+    bt.append_message(b"sign:R", _rows(rs))
+    out = bt.challenge_bytes(b"sign:c", 64)
+
+    for i in range(n):
+        t = Transcript(b"SigningContext")
+        t.append_message(b"", b"substrate")
+        t.append_message(b"sign-bytes", msgs[i])
+        t.append_message(b"proto-name", b"Schnorr-sig")
+        t.append_message(b"sign:pk", pks[i])
+        t.append_message(b"sign:R", rs[i])
+        assert out[i].tobytes() == t.challenge_bytes(b"sign:c", 64), i
+
+
+def test_batch_rate_boundary_and_multiple_challenges():
+    rng = np.random.default_rng(6)
+    # messages longer than the 166-byte STROBE rate force mid-op permutations
+    longs = [bytes(rng.integers(0, 256, 400, dtype=np.uint8)) for _ in range(4)]
+    bt = BatchTranscript(b"L", 4)
+    bt.append_message(b"m", _rows(longs))
+    c1 = bt.challenge_bytes(b"c1", 32)
+    c2 = bt.challenge_bytes(b"c2", 200)  # squeeze across the rate boundary
+    for i in range(4):
+        t = Transcript(b"L")
+        t.append_message(b"m", longs[i])
+        assert c1[i].tobytes() == t.challenge_bytes(b"c1", 32)
+        assert c2[i].tobytes() == t.challenge_bytes(b"c2", 200)
+
+
+def test_batch_challenge_feeds_sr25519_verification():
+    """The batched challenge drives the same verify verdict as the host
+    sr25519 path (crypto/batch._precheck_and_hash sr branch)."""
+    from tendermint_tpu.crypto.batch import _precheck_and_hash
+    from tendermint_tpu.crypto.ed25519_ref import L
+    from tendermint_tpu.crypto.sr25519 import (
+        _context_transcript,
+        _scalar_from_wide,
+        _sign_transcript,
+        gen_sr25519,
+    )
+
+    n = 6
+    pubkeys, msgs, sigs = [], [], []
+    for i in range(n):
+        priv = gen_sr25519(bytes([40 + i]) * 32)
+        m = b"merlin-batch-%02d-" % i + b"z" * (20 + 3 * (i % 2))  # two lengths
+        pubkeys.append(priv.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(priv.sign(m))
+    precheck, _, _, s_ints, hk_ints = _precheck_and_hash(
+        pubkeys, msgs, sigs, ["sr25519"] * n
+    )
+    assert precheck.all()
+    for i in range(n):
+        t = _sign_transcript(_context_transcript(msgs[i]), bytes(pubkeys[i]))
+        t.append_message(b"sign:R", sigs[i][:32])
+        k = _scalar_from_wide(t.challenge_bytes(b"sign:c", 64))
+        assert hk_ints[i] == k % L, i
