@@ -196,8 +196,15 @@ def bench_streaming(n: int, batches: int = 6):
     from tendermint_tpu.crypto import batch as B
 
     pubkeys, msgs, sigs, _ = make_batch(n)
-    # warm: compile + fill pubkey cache
-    assert B.verify_batch_jax(pubkeys, msgs, sigs).all()
+    # Warm the EXACT kernel variant + shape bucket the timed loop runs:
+    # prefill the pubkey cache, then one cached-A submit/finish round trip.
+    # (Warming via verify_batch_jax with a cold cache compiles the PLAIN
+    # kernel while the timed loop runs the CACHED one — a different
+    # program — which put a 100-200s compile inside the timed region in
+    # the round-3 driver run.)
+    B._fill_a_cache(np.stack([np.frombuffer(pk, dtype=np.uint8) for pk in pubkeys]))
+    warm = B._rlc_finish(B._rlc_submit(pubkeys, msgs, sigs))
+    assert warm is not None and warm.all()
     t0 = time.perf_counter()
     calls = [B._rlc_submit(pubkeys, msgs, sigs) for _ in range(batches)]
     masks = [B._rlc_finish(c) for c in calls]
@@ -230,8 +237,20 @@ def bench_fastsync_replay(n_blocks: int = 16, n_vals: int = 1024):
     cpu_s = time_cpu_serial(pks[:256], per_block[0][:256], per_block_sigs[0][:256])
     cpu_blocks_per_s = 1.0 / (cpu_s * (n_vals / 256))
 
-    # warm compile + pubkey cache
-    assert B.verify_batch_jax(pks, per_block[0], per_block_sigs[0]).all()
+    # Warm the EXACT kernel variant + shape the timed loop runs (cached-A
+    # submit at this lane bucket) — see bench_streaming for why warming via
+    # verify_batch_jax is NOT sufficient (plain vs cached kernel variants).
+    B._fill_a_cache(np.stack([np.frombuffer(pk, dtype=np.uint8) for pk in pks]))
+    warm = B._rlc_finish(B._rlc_submit(pks, per_block[0], per_block_sigs[0]))
+    assert warm is not None and warm.all()
+    # Sentinel: one timed single-block round trip, compared against the
+    # pipelined loop below — a compile sneaking into the timed region shows
+    # up as first_block_ms >> the per-block pipelined time.
+    t0 = time.perf_counter()
+    j = min(1, n_blocks - 1)
+    m0 = B._rlc_finish(B._rlc_submit(pks, per_block[j], per_block_sigs[j]))
+    first_block_s = time.perf_counter() - t0
+    assert m0 is not None and m0.all()
     t0 = time.perf_counter()
     calls = [B._rlc_submit(pks, per_block[i], per_block_sigs[i]) for i in range(n_blocks)]
     masks = [B._rlc_finish(c) for c in calls]
@@ -244,6 +263,7 @@ def bench_fastsync_replay(n_blocks: int = 16, n_vals: int = 1024):
         "n_vals": n_vals,
         "cpu_blocks_per_sec": round(cpu_blocks_per_s, 3),
         "tpu_blocks_per_sec": round(blocks_per_s, 3),
+        "first_block_ms": round(first_block_s * 1e3, 3),
         "sigs_per_sec": round(blocks_per_s * n_vals),
         "speedup": round(blocks_per_s / cpu_blocks_per_s, 2),
     }

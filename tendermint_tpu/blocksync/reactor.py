@@ -154,7 +154,7 @@ class BlocksyncReactor(Reactor):
         *punishes* when index 0 fails — later failures may just mean the set
         changed, and those heights are re-verified as the head of the next
         run against the then-correct set."""
-        pubkeys, msgs, sigs = [], [], []
+        pubkeys, msgs, sigs, key_types = [], [], [], []
         spans = []  # (start, count, powers, total_power, ok_struct)
         vals = self.state.validators
         for first, parts, second in run:
@@ -172,12 +172,15 @@ class BlocksyncReactor(Reactor):
                 pubkeys.append(val.pub_key.bytes())
                 msgs.append(commit.vote_sign_bytes(self.state.chain_id, idx))
                 sigs.append(cs_sig.signature)
+                key_types.append(val.pub_key.type_name())
                 powers.append(val.voting_power)
             ok_struct = commit.block_id == first_id and commit.height == first.header.height
             spans.append((start, len(sigs) - start, powers, vals.total_voting_power(), ok_struct))
         if not sigs:
             return 0 if run else None
-        mask = verify_batch(pubkeys, msgs, sigs)
+        # key_types: sr25519 validators' sigs must verify under sr25519 rules
+        # (mirrors validator_set.py batched Verify*; liveness in mixed sets).
+        mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
         for i, (start, count, powers, total, ok_struct) in enumerate(spans):
             if not ok_struct:
                 return i

@@ -9,11 +9,18 @@
   path (ops/msm_jax.py): ONE Pippenger multiscalar check over random 128-bit
   coefficients, ~10x less device work than per-signature ladders; if the
   combined check fails (any bad signature present), it falls back to the
-  per-signature kernel (ops/ed25519_jax.py) to recover the exact mask — so
-  externally the semantics are always per-sig accept/reject, matching the
-  reference (types/validator_set.go:680-702). Decompressed public keys are
-  cached across calls (consensus re-verifies the same validator set every
-  height), which removes ~1/3 of the device work in steady state.
+  per-signature kernel (ops/ed25519_jax.py) to recover the exact mask.
+  Decompressed public keys are cached across calls (consensus re-verifies
+  the same validator set every height), which removes ~1/3 of the device
+  work in steady state.
+
+Verification semantics are COFACTORED (ZIP-215-style) with canonical
+encodings and s < L on EVERY backend and path — cpu (OpenSSL fast path +
+pure-Python cofactored referee on reject), per-sig kernel, and RLC — so the
+accept/reject outcome never depends on which path or backend a node runs
+(see crypto/ed25519_ref.verify_cofactored). The reference's cofactorless
+loop (types/validator_set.go:680-702) agrees on all torsion-free (i.e. all
+honest) inputs.
 
 Every O(validators) verification site in the framework (VerifyCommit,
 VerifyCommitLight/Trusting, vote storms, fast-sync replay, evidence) funnels
@@ -626,6 +633,11 @@ def verify_batch(
             and _rlc_enabled()
             and len(pubkeys) >= RLC_MIN
             and _sharded_runner() is None
+            # the mixed kernel only knows these two types; any other row
+            # must take the exact per-type path (which marks unknown types
+            # False) — otherwise an unknown-type row carrying an
+            # ed25519-valid triple would diverge between paths
+            and all(t in ("ed25519", "sr25519") for t in key_types)
         ):
             mask = _verify_batch_rlc(pubkeys, msgs, sigs, key_types)
             if mask is not None:

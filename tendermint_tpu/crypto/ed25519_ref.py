@@ -7,10 +7,21 @@ Production host-side signing/verification goes through the `cryptography`
 package (OpenSSL); this module is only used in tests and as a last-resort
 fallback.
 
-Verification is *cofactorless*: accept iff [s]B == R + [h]A exactly (compared
-via compressed encodings) and s < L — the same check golang.org/x/crypto's
-ed25519 performs, which is what the reference consensus engine relies on
-(reference: crypto/ed25519/ed25519.go:148).
+Two verification predicates:
+
+- `verify` — *cofactorless*: accept iff [s]B == R + [h]A exactly (compared
+  via compressed encodings) and s < L — the same check golang.org/x/crypto's
+  ed25519 performs (reference: crypto/ed25519/ed25519.go:148).
+- `verify_cofactored` — the FRAMEWORK's canonical semantic (ZIP-215-style):
+  accept iff [8]([s]B - [h]A - R) == identity, with canonical encodings and
+  s < L required. Cofactored acceptance is a strict superset of cofactorless
+  (multiply the cofactorless equation by 8), differing only on crafted
+  small-torsion inputs; honest keys/sigs are torsion-free, where both agree.
+  Every verification path in the framework (host OpenSSL wrapper
+  crypto/keys.py, per-sig TPU kernel ops/ed25519_jax.py, RLC batch path
+  ops/msm_jax.py) implements exactly this predicate, so verification outcome
+  never depends on which path/backend a node runs — a consensus-fork
+  requirement at the 2/3 boundary.
 """
 
 from __future__ import annotations
@@ -166,3 +177,36 @@ def verify(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
     neg_a = (P - A[0], A[1], A[2], P - A[3])
     sB_hA = point_add(point_mul(s, BASE), point_mul(h, neg_a))
     return point_compress(sB_hA) == Rs
+
+
+def verify_cofactored(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+    """The framework's canonical verification predicate (see module doc):
+    [8]([s]B - [h]A - R) == identity, canonical encodings, s < L.
+
+    Used as the slow-path referee when OpenSSL (cofactorless) rejects a
+    signature (crypto/keys.py) — cofactored accepts a strict superset, so
+    the recheck only runs on already-rejected (rare) inputs."""
+    if len(pubkey) != 32 or len(sig) != 64:
+        return False
+    A = point_decompress(pubkey)  # enforces canonical y (< p)
+    if A is None:
+        return False
+    R = point_decompress(sig[:32])
+    if R is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    h = sha512_mod_l(sig[:32] + pubkey + msg)
+    neg_a = (P - A[0], A[1], A[2], P - A[3])
+    neg_r = (P - R[0], R[1], R[2], P - R[3])
+    q = point_add(point_add(point_mul(s, BASE), point_mul(h, neg_a)), neg_r)
+    for _ in range(3):  # multiply by the cofactor 8
+        q = point_double(q)
+    # Z != 0 guard, mirroring the device kernels: an exceptional unified
+    # addition on crafted torsion inputs can yield (0,0,0,0), whose cross
+    # products against the identity are all zero — that must read as
+    # REJECT, exactly as ops/ed25519_jax.py and ops/msm_jax.py read it.
+    if q[2] % P == 0:
+        return False
+    return point_equal(q, IDENTITY)

@@ -49,7 +49,11 @@ class KeyPath:
         out = []
         for key, enc in self._keys:
             if enc == KEY_ENCODING_URL:
-                out.append("/" + urllib.parse.quote(key.decode("latin-1"), safe=""))
+                # quote() on raw bytes percent-encodes each byte directly
+                # (%FF for 0xFF), matching Go's url.PathEscape byte-wise
+                # escaping; decoding via a str round-trip would re-encode
+                # high bytes as UTF-8 (%C3%BF) and break interop.
+                out.append("/" + urllib.parse.quote(key, safe=""))
             elif enc == KEY_ENCODING_HEX:
                 out.append("/x:" + key.hex())
             else:
@@ -67,7 +71,7 @@ def key_path_to_keys(path: str) -> List[bytes]:
         if part.startswith("x:"):
             keys.append(bytes.fromhex(part[2:]))
         else:
-            keys.append(urllib.parse.unquote(part).encode("latin-1"))
+            keys.append(urllib.parse.unquote_to_bytes(part))
     return keys
 
 

@@ -5,12 +5,15 @@ once, replacing the reference's serial per-signature loop
 (reference: types/validator_set.go:680-702, types/vote_set.go:203,
 crypto/ed25519/ed25519.go:148).
 
-Semantics: cofactorless verification — accept iff [s]B == R + [h]A exactly,
-computed as enc([s]B + [h](-A)) == enc(R), with s < L enforced host-side —
-the same equation golang.org/x/crypto/ed25519 checks. One (documented)
-divergence: we reject public keys whose y coordinate is non-canonical (>= p),
-which x/crypto accepts; honest keys are never affected (and non-canonical
-keys are refused at validator ingestion, crypto/keys.py).
+Semantics: COFACTORED verification (ZIP-215-style) — accept iff
+[8]([s]B + [h](-A) - R) == identity, with canonical A/R encodings and s < L
+(enforced host-side). This is the framework's single verification predicate:
+the host wrapper (crypto/keys.py), this kernel, and the RLC batch path
+(ops/msm_jax.py) all implement it exactly, so acceptance never depends on
+which path a node runs. Divergences from golang.org/x/crypto (cofactorless,
+accepts non-canonical A) exist only for crafted torsion/non-canonical
+inputs; honest keys and signatures are torsion-free and canonical, where
+all predicates agree (see crypto/ed25519_ref.verify_cofactored).
 
 Layout: batch on the TRAILING axis everywhere (limbs/bytes/digits leading) so
 the batch maps onto TPU vector lanes. Points are (X, Y, Z, T) extended twisted
@@ -294,7 +297,8 @@ def _verify_core(
     h_digits: jnp.ndarray,
     ctx: FieldCtx,
 ) -> jnp.ndarray:
-    """Core batched check: enc([s]B + [h](-A)) == enc(R). Returns bool[...batch]."""
+    """Core batched check (cofactored): [8]([s]B + [h](-A) - R) == identity.
+    Returns bool[...batch]."""
     a_bytes = jnp.asarray(a_bytes)
     r_bytes = jnp.asarray(r_bytes)
     s_digits = jnp.asarray(s_digits, dtype=jnp.int8).astype(jnp.int32)
@@ -302,6 +306,8 @@ def _verify_core(
 
     neg_a, ok_a = decompress(ctx, a_bytes)
     neg_a = point_neg(ctx, neg_a)
+    r_pt, ok_r = decompress(ctx, r_bytes)
+    r_pt = point_select(ok_r, r_pt, identity(ctx))
 
     # Per-signature table: j*(-A) for j=0..8 (identity, -A, 2(-A), ..., 8(-A)).
     entries = [identity(ctx), neg_a]
@@ -324,8 +330,16 @@ def _verify_core(
         return acc, None
 
     acc, _ = jax.lax.scan(step, identity(ctx), xs)
-    enc = compress(acc)
-    return ok_a & jnp.all(enc == r_bytes, axis=0)
+    # Cofactored acceptance: q = acc - R, then [8]q must be the identity.
+    # (Replacing the old enc(acc) == enc(R) compare also drops a field
+    # inversion from the kernel.) The z != 0 guard rejects the (0,0,0,0)
+    # output an exceptional unified addition on crafted torsion inputs
+    # could produce, instead of silently accepting it.
+    q = point_add(ctx, acc, point_neg(ctx, r_pt))
+    for _ in range(3):
+        q = point_double(ctx, q)
+    is_id = fe.is_zero(q.x) & fe.eq(q.y, q.z) & ~fe.is_zero(q.z)
+    return ok_a & ok_r & is_id
 
 
 _verify_jit = jax.jit(_verify_core)

@@ -15,13 +15,15 @@ deterministically, making the combined check exactly the COFACTORED batch
 equation [8] sum z'_i (s_i B - h_i A_i - R_i) == identity. If every
 per-signature cofactored equation holds the combination is the identity; if
 any fails, it is the identity with probability <= ~2^-120 over the z_i. The
-caller falls back to the per-signature kernel when the batch check fails,
-so externally-visible semantics stay per-sig accept/reject — RLC is an
-accelerator, not a replacement (reference semantics:
-types/validator_set.go:680-702 verifies each signature individually).
-Honest keys and signatures are torsion-free, where cofactored and
-cofactorless (the per-sig kernel / RFC 8032 either-is-fine) agree exactly;
-crafted torsion inputs get ZIP-215-style cofactored semantics on this path.
+caller falls back to the per-signature kernel when the batch check fails to
+recover the exact per-signature mask. COFACTORED (ZIP-215-style) is the
+framework's single verification predicate on EVERY path — this batch check,
+the per-sig kernel (ops/ed25519_jax.py), and the host wrapper
+(crypto/keys.py via ed25519_ref.verify_cofactored) — so acceptance never
+depends on which path a node runs. Honest keys and signatures are
+torsion-free, where cofactored agrees exactly with the reference's
+cofactorless check (types/validator_set.go:680-702); only crafted torsion
+inputs ever see the (deliberate, documented) divergence from Go.
 
 sr25519 (schnorrkel) shares the SAME equation shape (s B == R + k A over
 ristretto255, which is this curve quotiented by its torsion): sr lanes join
@@ -466,7 +468,10 @@ def _msm_is_identity(C: SmallCtx, pts: Point, perm, node_idx) -> jnp.ndarray:
     full-width one.)"""
     w_pts = _window_points(C, pts, perm, node_idx)  # (20, T)
     total = _combine_windows(C, w_pts)  # (20,)
-    return fe.is_zero(total.x) & fe.eq(total.y, total.z)
+    # z != 0 guard: an exceptional unified addition (possible only on
+    # crafted torsion inputs) yields (0,0,0,0), which must read as
+    # "check failed" (-> per-sig fallback), not as the identity.
+    return fe.is_zero(total.x) & fe.eq(total.y, total.z) & ~fe.is_zero(total.z)
 
 
 def _rlc_core(

@@ -81,24 +81,32 @@ class NAT:
         internal_client: str, description: str, lease_seconds: int = 0,
     ) -> None:
         """(upnp.go:348 AddPortMapping)"""
+        from xml.sax.saxutils import escape
+
+        protocol = protocol.upper()
+        if protocol not in ("TCP", "UDP"):
+            raise ValueError(f"protocol must be TCP or UDP, got {protocol!r}")
         args = (
             "<NewRemoteHost></NewRemoteHost>"
-            f"<NewExternalPort>{external_port}</NewExternalPort>"
-            f"<NewProtocol>{protocol.upper()}</NewProtocol>"
-            f"<NewInternalPort>{internal_port}</NewInternalPort>"
-            f"<NewInternalClient>{internal_client}</NewInternalClient>"
+            f"<NewExternalPort>{int(external_port)}</NewExternalPort>"
+            f"<NewProtocol>{protocol}</NewProtocol>"
+            f"<NewInternalPort>{int(internal_port)}</NewInternalPort>"
+            f"<NewInternalClient>{escape(internal_client)}</NewInternalClient>"
             "<NewEnabled>1</NewEnabled>"
-            f"<NewPortMappingDescription>{description}</NewPortMappingDescription>"
-            f"<NewLeaseDuration>{lease_seconds}</NewLeaseDuration>"
+            f"<NewPortMappingDescription>{escape(description)}</NewPortMappingDescription>"
+            f"<NewLeaseDuration>{int(lease_seconds)}</NewLeaseDuration>"
         )
         await self._soap("AddPortMapping", self._u("AddPortMapping", args))
 
     async def delete_port_mapping(self, protocol: str, external_port: int) -> None:
         """(upnp.go:384 DeletePortMapping)"""
+        protocol = protocol.upper()
+        if protocol not in ("TCP", "UDP"):
+            raise ValueError(f"protocol must be TCP or UDP, got {protocol!r}")
         args = (
             "<NewRemoteHost></NewRemoteHost>"
-            f"<NewExternalPort>{external_port}</NewExternalPort>"
-            f"<NewProtocol>{protocol.upper()}</NewProtocol>"
+            f"<NewExternalPort>{int(external_port)}</NewExternalPort>"
+            f"<NewProtocol>{protocol}</NewProtocol>"
         )
         await self._soap("DeletePortMapping", self._u("DeletePortMapping", args))
 

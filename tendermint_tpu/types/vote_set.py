@@ -204,13 +204,18 @@ class VoteSet:
         pop_conflicts()."""
         if not self._pending:
             return [], []
-        pubkeys, msgs, sigs = [], [], []
+        pubkeys, msgs, sigs, key_types = [], [], [], []
         for idx, vote in self._pending:
             _, val = self.val_set.get_by_index(idx)
             pubkeys.append(val.pub_key.bytes())
             msgs.append(vote.sign_bytes(self.chain_id))
             sigs.append(vote.signature)
-        mask = verify_batch(pubkeys, msgs, sigs)
+            key_types.append(val.pub_key.type_name())
+        # key_types matters: in a mixed validator set an sr25519 vote
+        # verified under ed25519 rules always fails (marker bit forces
+        # s >= L) — dropping valid votes on the deferred path would be a
+        # liveness break (mirrors validator_set.py batched Verify*).
+        mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
         committed = []
         failed = []
         for ok, (idx, vote) in zip(mask, self._pending):

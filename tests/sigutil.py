@@ -1,0 +1,24 @@
+"""Shared signature-crafting helpers for crypto tests."""
+
+import numpy as np
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+
+
+def torsion_defect_sig(seed: int = 7, msg: bytes = b"torsion-agreement"):
+    """A signature whose ONLY defect is small torsion in R: R' = [r]B + T2
+    with T2 the order-2 point (0, -1).
+
+    Cofactorless verification rejects it (the defect point -T2 is not the
+    identity); cofactored verification accepts ([8](-T2) == identity).
+    Used to assert every framework path implements the same cofactored
+    predicate (advisor r3 medium). Returns (pubkey, msg, sig)."""
+    rng = np.random.default_rng(seed)
+    a = int.from_bytes(rng.bytes(32), "little") % ref.L
+    a_enc = ref.point_compress(ref.point_mul(a, ref.BASE))
+    r = int.from_bytes(rng.bytes(32), "little") % ref.L
+    t2 = (0, ref.P - 1, 1, 0)
+    r_enc = ref.point_compress(ref.point_add(ref.point_mul(r, ref.BASE), t2))
+    h = ref.sha512_mod_l(r_enc + a_enc + msg)
+    s = (r + h * a) % ref.L
+    return a_enc, msg, r_enc + s.to_bytes(32, "little")

@@ -163,3 +163,27 @@ def test_armor_rejects_hostile_headers():
                  ("r", "9999"), ("nonce", "AB"), ("salt", "CD")):
         with pytest.raises(ArmorError):
             unarmor_decrypt_priv_key(with_header(k, v), "pw")
+
+
+def test_cofactored_is_the_single_framework_predicate():
+    """Advisor r3 (medium): verification outcome must not depend on which
+    path/backend a node runs. The framework predicate is cofactored
+    (ZIP-215-style): host wrapper and referee ACCEPT the pure-torsion-defect
+    signature that cofactorless x/crypto-style verification rejects; the
+    device kernels implement the same predicate (tests/test_ed25519_jax.py,
+    tests/test_msm_rlc.py cover the kernel side)."""
+    from tests.sigutil import torsion_defect_sig
+
+    a_enc, msg, sig = torsion_defect_sig()
+    assert not ref.verify(a_enc, msg, sig)  # cofactorless: reject
+    assert ref.verify_cofactored(a_enc, msg, sig)  # framework: accept
+    assert Ed25519PubKey(a_enc).verify(msg, sig)  # OpenSSL+referee: accept
+    # cofactored still rejects genuinely bad signatures
+    bad = bytearray(sig)
+    bad[33] ^= 1
+    assert not ref.verify_cofactored(a_enc, msg, bytes(bad))
+    assert not Ed25519PubKey(a_enc).verify(msg, bytes(bad))
+    # and non-canonical R encodings
+    bad_r = (2**255 - 10).to_bytes(32, "little") + sig[32:]
+    assert not ref.verify_cofactored(a_enc, msg, bad_r)
+    assert not Ed25519PubKey(a_enc).verify(msg, bad_r)

@@ -178,3 +178,20 @@ def test_batch_verifier_interface():
 
 def test_empty_batch():
     assert cbatch.verify_batch([], [], []).tolist() == []
+
+
+def test_persig_kernel_is_cofactored():
+    from tendermint_tpu.crypto.keys import Ed25519PubKey
+
+    from tests.sigutil import torsion_defect_sig
+
+    a_enc, msg, sig = torsion_defect_sig(seed=8, msg=b"kernel-torsion-agreement")
+    mask = cbatch.verify_batch_jax([a_enc], [msg], [sig])
+    assert mask.tolist() == [True]
+    # agrees with the host wrapper (OpenSSL fast path + cofactored referee)
+    assert Ed25519PubKey(a_enc).verify(msg, sig)
+    assert not ref.verify(a_enc, msg, sig)  # cofactorless would reject
+    # a genuinely bad signature still fails on the kernel
+    bad = bytearray(sig)
+    bad[34] ^= 1
+    assert cbatch.verify_batch_jax([a_enc], [msg], [bytes(bad)]).tolist() == [False]

@@ -168,3 +168,20 @@ def test_rlc_mixed_matches_host_verifiers(rlc_on):
         else:
             want = sr25519_verify(bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i]))
         assert got[i] == want, (i, types[i])
+
+
+def test_rlc_accepts_pure_torsion_defect_no_fallback(rlc_on):
+    """The RLC batch equation is cofactored: a signature whose only defect
+    is small torsion in R passes the combined check directly (no per-sig
+    fallback), agreeing with the per-sig kernel and the host wrapper —
+    the single framework predicate (advisor r3 medium)."""
+    from tests.sigutil import torsion_defect_sig
+
+    pubkeys, msgs, sigs = make_batch(12)
+    a_enc, msg, sig = torsion_defect_sig(seed=11, msg=b"rlc-torsion-agreement")
+    pubkeys.append(a_enc)
+    msgs.append(msg)
+    sigs.append(sig)
+    mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+    assert mask.all()
+    assert B.LAST_JAX_PATH[0] == "rlc"  # combined check passed, no fallback

@@ -104,3 +104,15 @@ def test_merkle_kvstore_app_proofs():
     # unproven query has no ops
     res2 = app.query(abci.RequestQuery(data=b"name"))
     assert res2.proof_ops is None
+
+
+def test_key_path_high_bytes_gowire_parity():
+    """Raw high bytes must escape byte-wise (%FF), matching Go's
+    url.PathEscape — a UTF-8 str round-trip would emit %C3%BF and break
+    cross-implementation keypath interop (advisor finding r3)."""
+    key = b"\xff\x00 high&/bytes"
+    kp = KeyPath().append_key(key, KEY_ENCODING_URL)
+    s = str(kp)
+    assert "%FF" in s.upper()
+    assert "%C3" not in s.upper()
+    assert key_path_to_keys(s) == [key]

@@ -350,3 +350,33 @@ def test_update_with_change_set_does_not_mutate_caller():
     assert new_val.proposer_priority == 0
     vs.update_with_change_set([new_val])
     assert new_val.proposer_priority == 0  # caller's object untouched
+
+
+def test_vote_set_deferred_flush_mixed_key_types():
+    """Deferred flush must verify each vote under ITS key type: an sr25519
+    vote checked as ed25519 always fails (marker bit forces s >= L), which
+    would silently drop valid votes — a liveness break in mixed sets
+    (advisor r3 medium; mirrors validator_set batched Verify*)."""
+    from tendermint_tpu.crypto.sr25519 import gen_sr25519
+
+    privs = [gen_ed25519(bytes([i + 1]) * 32) for i in range(3)] + [
+        gen_sr25519(b"\x77" * 32)
+    ]
+    vs = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    sorted_privs = [by_addr[v.address] for v in vs.validators]
+    vote_set = VoteSet(CHAIN, 5, 0, SignedMsgType.PRECOMMIT, vs, defer_verification=True)
+    for i, (val, priv) in enumerate(zip(vs.validators, sorted_privs)):
+        v = Vote(
+            type=SignedMsgType.PRECOMMIT,
+            height=5,
+            round=0,
+            block_id=BID,
+            timestamp_ns=0,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        vote_set.add_vote(v.with_signature(priv.sign(v.sign_bytes(CHAIN))))
+    committed, failed = vote_set.flush()
+    assert failed == []
+    assert len(committed) == 4  # the sr25519 vote survives the deferred path
