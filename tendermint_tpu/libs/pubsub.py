@@ -1,20 +1,35 @@
 """Query-indexed pub/sub (reference: libs/pubsub/pubsub.go:91 + query DSL).
 
 Events are (type, attributes) maps; subscriptions carry a Query that matches
-composite key=value conditions. The query language supports the subset the
-reference RPC actually uses: `key = 'value'`, `key = value`, conjunctions with
-AND, and the numeric comparisons =, <, <=, >, >= plus CONTAINS and EXISTS."""
+composite key=value conditions. The query language covers the reference
+grammar (reference: libs/pubsub/query/query.go): `key = 'value'`, numeric
+comparisons =, <, <=, >, >=, CONTAINS, EXISTS, conjunctions with AND, and
+chronological comparisons against `TIME <RFC3339>` / `DATE <YYYY-MM-DD>`
+operands (e.g. `block.timestamp >= TIME 2013-05-03T14:45:00Z`)."""
 
 from __future__ import annotations
 
 import asyncio
 import re
 from dataclasses import dataclass, field
+from datetime import date, datetime, timezone
 from typing import Dict, List, Optional, Tuple
 
 _CONDITION_RE = re.compile(
-    r"\s*([\w.]+)\s*(=|<=|>=|<|>|CONTAINS|EXISTS)\s*('(?:[^']*)'|\"(?:[^\"]*)\"|[\w.\-+]+)?\s*"
+    r"\s*([\w.]+)\s*(=|<=|>=|<|>|CONTAINS|EXISTS)\s*"
+    r"((?:TIME|DATE)\s+[\w.:+\-]+|'(?:[^']*)'|\"(?:[^\"]*)\"|[\w.\-+]+)?\s*"
 )
+
+
+def _parse_rfc3339(raw: str) -> datetime:
+    """RFC3339 timestamp or bare date -> aware datetime (UTC default)."""
+    s = raw.strip()
+    if s.endswith(("Z", "z")):
+        s = s[:-1] + "+00:00"
+    dt = datetime.fromisoformat(s)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt
 
 
 @dataclass(frozen=True)
@@ -22,6 +37,9 @@ class Condition:
     key: str
     op: str
     value: str = ""
+    # chronological operand: datetime parsed from TIME/DATE literals
+    # (reference: libs/pubsub/query/query.go time/date conditions)
+    time_value: Optional[datetime] = None
 
 
 class Query:
@@ -41,6 +59,18 @@ class Query:
                     continue
                 if raw is None:
                     raise ValueError(f"missing value in clause: {clause!r}")
+                if raw.startswith(("TIME ", "TIME\t", "DATE ", "DATE\t")):
+                    kind, _, lit = raw.partition(raw[4])
+                    try:
+                        if kind == "DATE":
+                            d = date.fromisoformat(lit.strip())
+                            tv = datetime(d.year, d.month, d.day, tzinfo=timezone.utc)
+                        else:
+                            tv = _parse_rfc3339(lit)
+                    except ValueError as e:
+                        raise ValueError(f"invalid {kind} literal in {clause!r}: {e}")
+                    self.conditions.append(Condition(key, op, lit.strip(), tv))
+                    continue
                 if raw[0] in "'\"":
                     raw = raw[1:-1]
                 self.conditions.append(Condition(key, op, raw))
@@ -51,6 +81,25 @@ class Query:
             if values is None:
                 return False
             if cond.op == "EXISTS":
+                continue
+            if cond.time_value is not None:
+                ok = False
+                for v in values:
+                    try:
+                        ev = _parse_rfc3339(v)
+                    except ValueError:
+                        continue
+                    if (
+                        (cond.op == "=" and ev == cond.time_value)
+                        or (cond.op == "<" and ev < cond.time_value)
+                        or (cond.op == "<=" and ev <= cond.time_value)
+                        or (cond.op == ">" and ev > cond.time_value)
+                        or (cond.op == ">=" and ev >= cond.time_value)
+                    ):
+                        ok = True
+                        break
+                if not ok:
+                    return False
                 continue
             if cond.op == "=":
                 if cond.value not in values:

@@ -219,7 +219,14 @@ class Node:
 
                 fuzz_cfg = FuzzConfig()
             transport = MultiplexTransport(self.node_key, node_info, fuzz_config=fuzz_cfg)
-            self.switch = Switch(transport, metrics=self.metrics.p2p)
+            trust_path = (
+                os.path.join(config.root_dir, "data", "trust_metrics.json")
+                if config.root_dir
+                else None
+            )
+            self.switch = Switch(
+                transport, metrics=self.metrics.p2p, trust_store_path=trust_path
+            )
             # fast sync is pointless when we are the only validator
             # (reference: node/node.go onlyValidatorIsUs)
             only_us = (

@@ -1,8 +1,9 @@
-"""Peer behaviour reporting + trust metric.
+"""Peer behaviour reporting + trust metric (+ persisted store).
 
 reference: behaviour/reporter.go + peer_behaviour.go (thin indirection for
-reactors to report peer conduct -> switch mark/stop) and p2p/trust/metric.go
-(EWMA-ish trust score per peer).
+reactors to report peer conduct -> switch mark/stop), p2p/trust/metric.go
+(EWMA-ish trust score per peer), and p2p/trust/store.go (metric store
+persisted across restarts so a peer's history survives).
 
 Wiring: the Switch owns a Reporter (switch.reporter); message delivery counts
 as good conduct and receive errors as bad, so every peer carries a live trust
@@ -11,11 +12,14 @@ score (exposed via /net_info). Reactors can report richer conduct directly.
 
 from __future__ import annotations
 
+import json
 import logging
+import os
+import tempfile
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Deque, Dict
+from typing import Deque, Dict, Optional
 
 logger = logging.getLogger("tendermint_tpu.p2p")
 
@@ -72,15 +76,72 @@ class TrustMetric:
         return self.good / total if total > 0 else 1.0
 
 
+class TrustStore:
+    """Persists peer trust metrics across restarts (reference:
+    p2p/trust/store.go TrustMetricStore — periodic + on-stop JSON snapshot;
+    restored scores seed the optimistic prior on reconnect)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> Dict[str, TrustMetric]:
+        try:
+            with open(self.path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        out: Dict[str, TrustMetric] = {}
+        if not isinstance(raw, dict):
+            return out
+        for peer_id, entry in raw.items():
+            try:
+                m = TrustMetric()
+                m.good = float(entry["good"])
+                m.bad = float(entry["bad"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            out[str(peer_id)] = m
+        return out
+
+    def save(self, metrics: Dict[str, TrustMetric]) -> None:
+        data = {
+            pid: {"good": m.good, "bad": m.bad, "score": m.score()}
+            for pid, m in metrics.items()
+        }
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".trust-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self.path)  # atomic: no torn store on crash
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
 class Reporter:
     """Routes behaviour reports to the switch: repeated bad conduct stops the
     peer (reference: behaviour/reporter.go SwitchReporter)."""
 
-    def __init__(self, switch=None, bad_threshold: float = 0.3, history_size: int = 1000):
+    def __init__(
+        self,
+        switch=None,
+        bad_threshold: float = 0.3,
+        history_size: int = 1000,
+        store: Optional[TrustStore] = None,
+    ):
         self.switch = switch
         self.bad_threshold = bad_threshold
-        self.metrics: Dict[str, TrustMetric] = {}
+        self.store = store
+        self.metrics: Dict[str, TrustMetric] = store.load() if store else {}
         self.history: Deque[PeerBehaviour] = deque(maxlen=history_size)
+
+    def save(self) -> None:
+        if self.store is not None:
+            self.store.save(self.metrics)
 
     MAX_TRACKED = 4096  # node ids are attacker-generated; bound the map
 

@@ -102,7 +102,12 @@ class SecretConnection:
             raise HandshakeError("bad ephemeral key length")
         remote_eph = await reader.readexactly(32)
 
-        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+        try:
+            # cryptography raises on an all-zero shared secret (low-order /
+            # small-subgroup ephemeral — an evil peer forcing a known key)
+            shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+        except ValueError as e:
+            raise HandshakeError(f"bad ephemeral point: {e}") from e
 
         low_is_us = eph_pub < remote_eph
         lo, hi = (eph_pub, remote_eph) if low_is_us else (remote_eph, eph_pub)
@@ -215,7 +220,11 @@ class SyncSecretConnection:
             raise HandshakeError("bad ephemeral key length")
         remote_eph = _recv_exact(sock, 32)
 
-        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+        try:
+            # see async upgrade: low-order ephemeral -> all-zero shared secret
+            shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+        except ValueError as e:
+            raise HandshakeError(f"bad ephemeral point: {e}") from e
         low_is_us = eph_pub < remote_eph
         lo, hi = (eph_pub, remote_eph) if low_is_us else (remote_eph, eph_pub)
         recv_secret, send_secret, challenge_lo = _hkdf(shared + lo + hi)

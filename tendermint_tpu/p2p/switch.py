@@ -27,11 +27,21 @@ RECONNECT_BASE_DELAY = 0.5
 
 
 class Switch:
-    def __init__(self, transport: MultiplexTransport, max_peers: int = 50, metrics=None):
-        from tendermint_tpu.p2p.behaviour import Reporter
+    def __init__(
+        self,
+        transport: MultiplexTransport,
+        max_peers: int = 50,
+        metrics=None,
+        trust_store_path: str | None = None,
+    ):
+        from tendermint_tpu.p2p.behaviour import Reporter, TrustStore
 
         self.metrics = metrics
-        self.reporter = Reporter(self)
+        # trust metrics survive restarts when a store path is configured
+        # (reference: p2p/trust/store.go; saved periodically + on stop)
+        self.reporter = Reporter(
+            self, store=TrustStore(trust_store_path) if trust_store_path else None
+        )
         self.transport = transport
         self.peers = PeerSet()
         self.reactors: Dict[str, Reactor] = {}
@@ -62,11 +72,22 @@ class Switch:
         )
         return reactor
 
+    TRUST_SAVE_INTERVAL = 60.0  # reference: p2p/trust/store.go saves each minute
+
     async def start(self) -> None:
         self._running = True
         for reactor in self.reactors.values():
             await reactor.start()
         self._tasks.append(asyncio.create_task(self._accept_routine(), name="sw-accept"))
+        if self.reporter.store is not None:
+            self._tasks.append(
+                asyncio.create_task(self._trust_save_routine(), name="sw-trust-save")
+            )
+
+    async def _trust_save_routine(self) -> None:
+        while self._running:
+            await asyncio.sleep(self.TRUST_SAVE_INTERVAL)
+            self.reporter.save()
 
     async def stop(self) -> None:
         self._running = False
@@ -76,6 +97,7 @@ class Switch:
             await self._stop_and_remove_peer(peer, None)
         for reactor in self.reactors.values():
             await reactor.stop()
+        self.reporter.save()
         await self.transport.close()
 
     # -- peer lifecycle ----------------------------------------------------

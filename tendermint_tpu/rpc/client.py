@@ -1,13 +1,16 @@
 """RPC clients (reference: rpc/client/http + rpc/client/local).
 
-HTTPClient speaks JSON-RPC over HTTP (aiohttp) to any node's RPC server;
-LocalClient calls the in-process server handlers directly (backs the light
-client's provider and tests without a socket, reference: rpc/client/local)."""
+HTTPClient speaks JSON-RPC over HTTP (aiohttp) to any node's RPC server, and
+lazily opens a /websocket side-channel for event subscriptions (reference:
+rpc/client/http/http.go embeds a WSEvents client); LocalClient calls the
+in-process server handlers directly (backs the light client's provider and
+tests without a socket, reference: rpc/client/local)."""
 
 from __future__ import annotations
 
+import asyncio
 import json
-from typing import Optional
+from typing import Dict, Optional
 
 import aiohttp
 
@@ -26,6 +29,7 @@ class HTTPClient:
             base_url = "http://" + base_url.replace("tcp://", "")
         self.base_url = base_url.rstrip("/")
         self._session: Optional[aiohttp.ClientSession] = None
+        self._ws: Optional["WSEventClient"] = None
         self._id = 0
 
     async def _ensure(self) -> aiohttp.ClientSession:
@@ -34,8 +38,42 @@ class HTTPClient:
         return self._session
 
     async def close(self) -> None:
+        if self._ws is not None:
+            await self._ws.close()
+            self._ws = None
         if self._session and not self._session.closed:
             await self._session.close()
+
+    # -- websocket subscriptions (reference: rpc/client/http WSEvents) ------
+
+    async def _ws_events(self) -> "WSEventClient":
+        if self._ws is None or not self._ws.running:
+            if self._ws is not None:
+                await self._ws.close()  # release the dead session/socket
+            self._ws = WSEventClient(self.base_url)
+            await self._ws.start()
+        return self._ws
+
+    async def subscribe(self, query: str) -> "WSSubscription":
+        """Subscribe to events matching a pubsub query over the websocket
+        side-channel; returns a WSSubscription with `next()`."""
+        ws = await self._ws_events()
+        return await ws.subscribe(query)
+
+    async def unsubscribe_all(self) -> None:
+        if self._ws is not None and self._ws.running:
+            await self._ws.unsubscribe_all()
+
+    async def wait_for_tx(self, tx_hash: bytes, timeout: float = 30.0) -> dict:
+        """Client-side broadcast_tx_commit wait: subscribe to the tx's
+        DeliverTx event by hash (the same query the server-side
+        broadcast_tx_commit route uses, reference: rpc/core/mempool.go) and
+        block until it fires."""
+        sub = await self.subscribe(f"tm.event = 'Tx' AND tx.hash = '{tx_hash.hex().upper()}'")
+        try:
+            return await asyncio.wait_for(sub.next(), timeout)
+        finally:
+            await sub.unsubscribe()
 
     async def call(self, method: str, **params):
         session = await self._ensure()
@@ -102,6 +140,155 @@ class HTTPClient:
 
     async def dump_consensus_state(self):
         return await self.call("dump_consensus_state")
+
+
+class WSSubscription:
+    """One active websocket subscription: `next()` yields event payloads
+    ({"query": ..., "events": {...}, "data": {...}})."""
+
+    def __init__(self, client: "WSEventClient", sub_id: int, query: str):
+        self._client = client
+        self._id = sub_id
+        self.query = query
+        self._queue: asyncio.Queue = asyncio.Queue()
+
+    async def next(self) -> dict:
+        item = await self._queue.get()
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    async def unsubscribe(self) -> None:
+        await self._client._drop(self._id)
+
+
+class WSEventClient:
+    """JSON-RPC over one /websocket connection: regular calls plus
+    query-indexed event subscriptions (reference: rpc/client/http/http.go
+    WSEvents + rpc/jsonrpc/client/ws_client.go).
+
+    Frame routing: responses and subscription events share the request id —
+    the first frame for an id resolves the pending call future, every later
+    frame with that id is a subscription event routed to its queue."""
+
+    def __init__(self, base_url: str):
+        if not base_url.startswith("http"):
+            base_url = "http://" + base_url.replace("tcp://", "")
+        self._url = base_url.rstrip("/") + "/websocket"
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._ws: Optional[aiohttp.ClientWebSocketResponse] = None
+        self._reader: Optional[asyncio.Task] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._subs: Dict[int, WSSubscription] = {}
+        self._id = 0
+        self.running = False
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession()
+        self._ws = await self._session.ws_connect(self._url)
+        self.running = True
+        self._reader = asyncio.create_task(self._read_loop())
+
+    async def close(self) -> None:
+        self.running = False
+        if self._reader is not None:
+            self._reader.cancel()
+            try:
+                await self._reader
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._reader = None
+        if self._ws is not None and not self._ws.closed:
+            await self._ws.close()
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def _read_loop(self) -> None:
+        err: Exception = RPCError(-1, "ws connection closed")
+        try:
+            async for msg in self._ws:
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    continue
+                try:
+                    body = json.loads(msg.data)
+                except json.JSONDecodeError:
+                    continue
+                id_ = body.get("id")
+                fut = self._pending.pop(id_, None)
+                if fut is not None:
+                    if not fut.done():
+                        if body.get("error"):
+                            e = body["error"]
+                            fut.set_exception(
+                                RPCError(e.get("code", -1), e.get("message", ""),
+                                         e.get("data", ""))
+                            )
+                        else:
+                            fut.set_result(body.get("result"))
+                    continue
+                sub = self._subs.get(id_)
+                if sub is not None and body.get("result"):
+                    sub._queue.put_nowait(body["result"])
+        except Exception as e:
+            err = e
+        finally:
+            # Reached on BOTH error and clean server close: mark the client
+            # dead (so HTTPClient._ws_events reconnects) and fail everything
+            # in flight — a pending call or subscription must never await a
+            # closed connection forever.
+            self.running = False
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+            for sub in self._subs.values():
+                sub._queue.put_nowait(err)
+
+    async def call(self, method: str, **params):
+        self._id += 1
+        id_ = self._id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[id_] = fut
+        await self._ws.send_json(
+            {"jsonrpc": "2.0", "id": id_, "method": method, "params": params}
+        )
+        return await fut
+
+    async def subscribe(self, query: str) -> WSSubscription:
+        self._id += 1
+        id_ = self._id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[id_] = fut
+        sub = WSSubscription(self, id_, query)
+        # Register BEFORE sending: the ack and the first event can arrive in
+        # one read-loop slice, and an event routed while we await the ack
+        # must land in the queue, not be dropped.
+        self._subs[id_] = sub
+        await self._ws.send_json(
+            {"jsonrpc": "2.0", "id": id_, "method": "subscribe",
+             "params": {"query": query}}
+        )
+        try:
+            await fut  # ack (or RPCError)
+        except Exception:
+            self._subs.pop(id_, None)
+            raise
+        return sub
+
+    async def _drop(self, sub_id: int) -> None:
+        sub = self._subs.pop(sub_id, None)
+        if sub is not None:
+            try:
+                await self.call("unsubscribe", query=sub.query)
+            except Exception:
+                pass
+
+    async def unsubscribe_all(self) -> None:
+        try:
+            await self.call("unsubscribe_all")
+        except Exception:
+            pass
+        self._subs.clear()
 
 
 class LocalClient:

@@ -184,6 +184,15 @@ class RPCServer:
                             pass
 
                     tasks.append(asyncio.create_task(pump()))
+                elif method == "unsubscribe":
+                    # by query, mirroring the reference's /unsubscribe route
+                    # (reference: rpc/core/events.go Unsubscribe)
+                    try:
+                        q = Query(params.get("query", ""))
+                        self.node.event_bus.unsubscribe(subscriber, q)
+                        await ws.send_json(_result(id_, {}))
+                    except Exception as e:
+                        await ws.send_json(_error(id_, -32603, "unsubscribe failed", str(e)))
                 elif method == "unsubscribe_all":
                     self.node.event_bus.unsubscribe_all(subscriber)
                     await ws.send_json(_result(id_, {}))

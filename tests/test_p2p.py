@@ -203,3 +203,39 @@ def test_dial_wrong_id_rejected():
         await sw1.stop()
 
     asyncio.run(run())
+
+
+def test_trust_store_persists_across_restart(tmp_path):
+    """(reference: p2p/trust/store.go — metric history survives restarts)"""
+    import asyncio
+
+    from tendermint_tpu.p2p.behaviour import (
+        BAD_MESSAGE,
+        CONSENSUS_VOTE,
+        PeerBehaviour,
+        Reporter,
+        TrustStore,
+    )
+
+    path = str(tmp_path / "trust.json")
+    rep = Reporter(store=TrustStore(path))
+
+    async def drive():
+        for _ in range(5):
+            await rep.report(PeerBehaviour("peer-a", CONSENSUS_VOTE))
+        for _ in range(3):
+            await rep.report(PeerBehaviour("peer-b", BAD_MESSAGE))
+
+    asyncio.run(drive())
+    assert rep.score("peer-a") > 0.9
+    assert rep.score("peer-b") < 0.5
+    rep.save()
+
+    rep2 = Reporter(store=TrustStore(path))
+    assert rep2.score("peer-a") > 0.9
+    assert rep2.score("peer-b") < 0.5
+    # corrupt store file -> clean fallback, no crash
+    with open(path, "w") as f:
+        f.write("{not json")
+    rep3 = Reporter(store=TrustStore(path))
+    assert rep3.score("peer-a") == 1.0  # optimistic prior

@@ -1,0 +1,82 @@
+"""Query DSL + pubsub server (libs/pubsub.py; reference: libs/pubsub/query
+query_test.go grammar cases, libs/pubsub/pubsub.go subscription policy)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.libs.pubsub import PubSubServer, Query
+
+
+def ev(**kw):
+    return {k.replace("__", "."): [str(v)] for k, v in kw.items()}
+
+
+def test_query_equals_and_and():
+    q = Query("tm.event = 'Tx' AND tx.height = 5")
+    assert q.matches({"tm.event": ["Tx"], "tx.height": ["5"]})
+    assert not q.matches({"tm.event": ["Tx"], "tx.height": ["6"]})
+    assert not q.matches({"tm.event": ["NewBlock"], "tx.height": ["5"]})
+
+
+def test_query_numeric_comparisons():
+    q = Query("account.balance >= 100 AND account.balance < 200")
+    assert q.matches({"account.balance": ["150"]})
+    assert not q.matches({"account.balance": ["99"]})
+    assert not q.matches({"account.balance": ["200"]})
+
+
+def test_query_contains_exists():
+    q = Query("tx.memo CONTAINS 'abc' AND tx.fee EXISTS")
+    assert q.matches({"tx.memo": ["xxabcyy"], "tx.fee": ["1"]})
+    assert not q.matches({"tx.memo": ["zz"], "tx.fee": ["1"]})
+    assert not q.matches({"tx.memo": ["xxabcyy"]})
+
+
+def test_query_time_comparisons():
+    """TIME literals compare chronologically, not lexically/numerically
+    (reference: libs/pubsub/query/query.go time conditions)."""
+    q = Query("block.timestamp >= TIME 2013-05-03T14:45:00Z")
+    assert q.matches({"block.timestamp": ["2013-05-03T14:45:01Z"]})
+    assert q.matches({"block.timestamp": ["2014-01-01T00:00:00Z"]})
+    assert not q.matches({"block.timestamp": ["2013-05-03T14:44:59Z"]})
+    # offsets are honored: 15:45+01:00 == 14:45Z
+    assert q.matches({"block.timestamp": ["2013-05-03T15:45:00+01:00"]})
+    assert not q.matches({"block.timestamp": ["2013-05-03T15:44:59+01:00"]})
+    # non-time attribute values simply don't match
+    assert not q.matches({"block.timestamp": ["not-a-time"]})
+
+
+def test_query_date_comparisons():
+    q = Query("block.date = DATE 2013-05-03")
+    assert q.matches({"block.date": ["2013-05-03"]})
+    assert not q.matches({"block.date": ["2013-05-04"]})
+    q2 = Query("block.date > DATE 2013-05-03")
+    assert q2.matches({"block.date": ["2013-05-04"]})
+    # a full timestamp on the same day is after midnight
+    assert q2.matches({"block.date": ["2013-05-03T10:00:00Z"]})
+    assert not q2.matches({"block.date": ["2013-05-03"]})
+
+
+def test_query_time_rejects_bad_literals():
+    with pytest.raises(ValueError):
+        Query("a.b = TIME not-a-time")
+    with pytest.raises(ValueError):
+        Query("a.b = DATE 2013-13-90")
+
+
+def test_pubsub_publish_and_slow_subscriber_cancel():
+    async def run():
+        srv = PubSubServer()
+        sub = srv.subscribe("s1", Query("tm.event = 'Tx'"), out_capacity=2)
+        srv.publish("d1", {"tm.event": ["Tx"]})
+        srv.publish("ignored", {"tm.event": ["NewBlock"]})
+        m = await sub.next()
+        assert m.data == "d1"
+        # overflow cancels the subscriber (reference: pubsub.go full-buffer policy)
+        for _ in range(4):
+            srv.publish("x", {"tm.event": ["Tx"]})
+        assert sub.cancelled
+        assert srv.num_client_subscriptions("s1") == 0
+
+    asyncio.run(run())

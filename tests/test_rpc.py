@@ -254,3 +254,48 @@ def test_unsafe_profile_dump_routes(tmp_path):
             await node.stop()
 
     asyncio.run(run())
+
+
+def test_websocket_subscription_client(tmp_path):
+    """WS event client (reference: rpc/client/http WSEvents): subscribe to
+    NewBlock + Tx events over /websocket, client-side broadcast-and-wait."""
+
+    async def run():
+        node = make_node(tmp_path, rpc_port=0)
+        import socket as s
+
+        sock = s.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        node.config.rpc.laddr = f"tcp://127.0.0.1:{port}"
+        await node.start()
+        client = HTTPClient(f"http://127.0.0.1:{port}")
+        try:
+            # event subscription: next block shows up
+            sub = await client.subscribe("tm.event = 'NewBlock'")
+            ev = await asyncio.wait_for(sub.next(), 30)
+            assert ev["events"]["tm.event"] == ["NewBlock"]
+            # plain RPC calls ride the same ws connection
+            ws = await client._ws_events()
+            st = await ws.call("status")
+            assert st["node_info"]["network"] == "rpc-chain"
+            # client-side broadcast_tx_commit wait (subscribe by tx.hash,
+            # fire the tx, await its DeliverTx event)
+            tx = b"ws=commit"
+            waiter = asyncio.create_task(
+                client.wait_for_tx(tmhash.sum256(tx), timeout=30)
+            )
+            await asyncio.sleep(0.05)  # subscription in flight first
+            await client.broadcast_tx_sync(tx)
+            ev = await waiter
+            assert ev["events"]["tx.hash"] == [tmhash.sum256(tx).hex().upper()]
+            # per-query unsubscribe leaves the NewBlock sub alive
+            ev2 = await asyncio.wait_for(sub.next(), 30)
+            assert ev2["events"]["tm.event"] == ["NewBlock"]
+            await sub.unsubscribe()
+        finally:
+            await client.close()
+            await node.stop()
+
+    asyncio.run(run())

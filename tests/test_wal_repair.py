@@ -1,0 +1,183 @@
+"""WAL corruption/truncation repair (consensus/wal.py non-strict decode).
+
+The code path that matters most after a crash: a torn tail write, a flipped
+byte, or raw garbage must never take the node down or feed it corrupted
+messages — replay recovers the longest clean prefix and the last complete
+height stays findable. Spirit of the reference's truncation-repair and fuzz
+harnesses (reference: consensus/wal_test.go, consensus/wal_fuzz.go)."""
+
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.consensus.messages import HasVoteMessage
+from tendermint_tpu.consensus.wal import (
+    WAL,
+    CorruptedWALError,
+    EndHeightMessage,
+    EventRoundState,
+    MsgInfo,
+    TimeoutInfo,
+)
+
+
+def write_sample_wal(path, heights=3, msgs_per_height=4):
+    """A realistic WAL: per height, a few messages then the EndHeight marker.
+    Returns (wal, flat list of messages written, including the initial
+    EndHeight(0) anchor)."""
+    wal = WAL(str(path))
+    written = [EndHeightMessage(0)]
+    for h in range(1, heights + 1):
+        for r in range(msgs_per_height):
+            batch = [
+                EventRoundState(h, r, 1),
+                TimeoutInfo(1.25, h, r, 1),
+                MsgInfo(HasVoteMessage(h, r, 1, r % 7), peer_id="peer-%d" % r),
+            ]
+            for m in batch:
+                wal.write(m)
+                written.append(m)
+        wal.write_end_height(h)
+        written.append(EndHeightMessage(h))
+    wal.flush_and_sync()
+    return wal, written
+
+
+def test_clean_roundtrip(tmp_path):
+    wal, written = write_sample_wal(tmp_path / "wal")
+    got = list(wal.iter_messages(strict=True))
+    assert got == written
+    wal.close()
+
+
+def test_truncation_at_every_tail_byte(tmp_path):
+    """Chop the file at every offset in the last two frames: non-strict decode
+    must yield a clean prefix (never a corrupted message, never an
+    exception), strict must raise."""
+    wal, written = write_sample_wal(tmp_path / "wal")
+    wal.close()
+    path = tmp_path / "wal"
+    blob = path.read_bytes()
+    # frame boundaries for prefix-validity checks
+    bounds = []
+    pos = 0
+    while pos < len(blob):
+        _, length = struct.unpack_from(">II", blob, pos)
+        pos += 8 + length
+        bounds.append(pos)
+    assert pos == len(blob)
+    start = bounds[-3]  # cut anywhere in the last two frames
+    for cut in range(start, len(blob)):
+        path.write_bytes(blob[:cut])
+        wal2 = WAL(str(path))
+        got = list(wal2.iter_messages())
+        n_complete = sum(1 for b in bounds if b <= cut)
+        assert got == written[:n_complete], f"cut={cut}"
+        if cut not in bounds:
+            with pytest.raises(CorruptedWALError):
+                list(wal2.iter_messages(strict=True))
+        wal2.close()
+    path.write_bytes(blob)  # restore
+
+
+def test_bitflip_anywhere_stops_cleanly(tmp_path):
+    """Flip one byte at a sample of positions: non-strict decode yields a
+    prefix of the written messages (the corrupted frame and everything after
+    it are dropped); strict raises."""
+    wal, written = write_sample_wal(tmp_path / "wal")
+    wal.close()
+    path = tmp_path / "wal"
+    blob = bytearray(path.read_bytes())
+    rng = np.random.default_rng(5)
+    for pos in sorted(rng.choice(len(blob), size=40, replace=False).tolist()):
+        mutated = bytearray(blob)
+        mutated[pos] ^= 0x41
+        path.write_bytes(bytes(mutated))
+        wal2 = WAL(str(path))
+        got = list(wal2.iter_messages())
+        # must be a strict prefix of what was written (nothing fabricated)
+        assert len(got) < len(written)
+        assert got == written[: len(got)], f"pos={pos}"
+        with pytest.raises(CorruptedWALError):
+            list(wal2.iter_messages(strict=True))
+        wal2.close()
+    path.write_bytes(bytes(blob))
+
+
+def test_garbage_tail_fuzz(tmp_path):
+    """Append random garbage after a valid WAL (torn rotation, disk noise):
+    decode always terminates, yields at least the clean prefix, and never
+    raises in non-strict mode."""
+    rng = np.random.default_rng(17)
+    for trial in range(10):
+        path = tmp_path / ("wal%d" % trial)
+        wal, written = write_sample_wal(path, heights=2, msgs_per_height=2)
+        wal.close()
+        garbage = rng.integers(0, 256, rng.integers(1, 200), dtype=np.uint8).tobytes()
+        with open(path, "ab") as f:
+            f.write(garbage)
+        wal2 = WAL(str(path))
+        got = list(wal2.iter_messages())
+        assert got[: len(written)] == written
+        # anything past the clean prefix must itself have decoded from a
+        # crc-valid frame; either way the iterator terminated
+        wal2.close()
+
+
+def test_pure_garbage_file(tmp_path):
+    rng = np.random.default_rng(3)
+    path = tmp_path / "wal"
+    path.write_bytes(rng.integers(0, 256, 4096, dtype=np.uint8).tobytes())
+    wal = WAL(str(path))
+    assert list(wal.iter_messages()) in ([], list(wal.iter_messages()))
+    assert wal.search_for_end_height(1) is None
+    wal.close()
+
+
+def test_search_for_end_height_survives_torn_tail(tmp_path):
+    """The catchup-replay anchor (search_for_end_height) must still find the
+    last COMPLETE height when the in-flight height's tail is torn — this is
+    exactly the crash-recovery read path (cs_state._catchup_replay)."""
+    wal, written = write_sample_wal(tmp_path / "wal", heights=3)
+    # start height 4, crash mid-write
+    wal.write(EventRoundState(4, 0, 1))
+    wal.write(TimeoutInfo(3.0, 4, 0, 1))
+    wal.flush_and_sync()
+    wal.close()
+    path = tmp_path / "wal"
+    blob = path.read_bytes()
+    path.write_bytes(blob[:-5])  # torn final frame
+    wal2 = WAL(str(path))
+    after = wal2.search_for_end_height(3)
+    assert after is not None
+    assert after == [EventRoundState(4, 0, 1)]  # torn timeout dropped
+    # height 4's marker is absent, as expected mid-height
+    assert wal2.search_for_end_height(4) is None
+    wal2.close()
+
+
+def test_corruption_in_rotated_file_does_not_fabricate(tmp_path):
+    """Corruption inside an EARLIER rotated file stops replay at that point
+    (longest clean prefix semantics across the whole group)."""
+    path = tmp_path / "wal"
+    wal = WAL(str(path), head_size_limit=256)  # force rotation quickly
+    written = [EndHeightMessage(0)]
+    for h in range(1, 6):
+        for r in range(4):
+            m = EventRoundState(h, r, 2)
+            wal.write(m)
+            written.append(m)
+        wal.write_end_height(h)
+        written.append(EndHeightMessage(h))
+    wal.close()
+    rotated = tmp_path / "wal.000"
+    assert rotated.exists()
+    blob = bytearray(rotated.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    rotated.write_bytes(bytes(blob))
+    wal2 = WAL(str(path))
+    got = list(wal2.iter_messages())
+    assert got == written[: len(got)] and len(got) < len(written)
+    wal2.close()
