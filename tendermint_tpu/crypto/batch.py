@@ -68,9 +68,13 @@ def _lane_bucket(m: int) -> int:
 # latency is fine and each extra RLC shape costs a long one-time compile.
 RLC_MIN = int(os.environ.get("TMTPU_RLC_MIN", "512"))
 
-# Below this, auto-selected "jax" routes to the host loop instead (device
-# round-trip latency dominates tiny batches).
-_JAX_MIN_BATCH = int(os.environ.get("TMTPU_JAX_MIN", "64"))
+# Below this, auto-selected "jax" routes to the host loop instead. A one-shot
+# small batch is round-trip-latency-bound (the device answer costs ~2 RTT +
+# dispatch regardless of size), so the crossover vs the ~115us/sig host loop
+# sits at a few hundred signatures even colocated — and far higher through a
+# tunnel. Live consensus accumulates votes and flushes at validator-set size
+# (types/vote_set.py), so real flushes land above this threshold.
+_JAX_MIN_BATCH = int(os.environ.get("TMTPU_JAX_MIN", "256"))
 
 
 def _rlc_enabled() -> bool:
@@ -253,6 +257,13 @@ _A_CACHE_MAX = 65536
 # block instead of a 10k-iteration Python loop (see _a_block).
 _A_STORE = np.empty((4, 20, 1024), dtype=np.int32)
 _A_STORE_LEN = 0
+# The background prewarm thread (node startup) and the consensus event loop
+# can fill the cache concurrently; an unlocked col=_A_STORE_LEN; write; +=1
+# sequence could alias two pubkeys to one column — which would make the
+# cached-A equation verify one validator's signatures against ANOTHER key's
+# coordinates. Every fill holds this lock (reads are safe: columns are
+# write-once and the store only grows by copy).
+_A_LOCK = __import__("threading").Lock()
 
 
 def _cache_key(pk: bytes, key_type: str) -> bytes:
@@ -260,7 +271,13 @@ def _cache_key(pk: bytes, key_type: str) -> bytes:
 
 
 def _fill_a_cache(rows: "np.ndarray", key_type: str = "ed25519") -> None:
-    """Decode unique pubkey rows on device and populate the cache."""
+    """Decode unique pubkey rows on device and populate the cache.
+    Thread-safe (prewarm thread vs event loop; see _A_LOCK)."""
+    with _A_LOCK:
+        _fill_a_cache_locked(rows, key_type)
+
+
+def _fill_a_cache_locked(rows: "np.ndarray", key_type: str) -> None:
     global _A_STORE, _A_STORE_LEN
     if key_type == "sr25519":
         from tendermint_tpu.ops.ristretto_jax import decode_rows as _decode
@@ -337,6 +354,17 @@ def _sample_z(rng, n: int, precheck) -> list:
     ]
 
 
+def _rlc_scalars(precheck, s_ints, hk_ints, n: int):
+    """Shared RLC coefficient/scalar derivation (single-device submit AND the
+    sharded path — keep them identical: the torsion-exact L8 reduction is
+    consensus-relevant). Returns (zs, w_scalars, u)."""
+    rng = np.random.default_rng()  # OS-entropy seeded per call
+    zs = _sample_z(rng, n, precheck)
+    w_scalars = [zs[i] * hk_ints[i] % L8 if precheck[i] else 0 for i in range(n)]
+    u = sum(zs[i] * s_ints[i] for i in range(n) if precheck[i]) % L
+    return zs, w_scalars, u
+
+
 def _rlc_submit(
     pubkeys: Sequence[bytes],
     msgs: Sequence[bytes],
@@ -392,13 +420,9 @@ def _rlc_submit(
         if precheck[i] and _A_CACHE.get(ckeys[i], True) is None:
             precheck[i] = False
 
-    rng = np.random.default_rng()  # OS-entropy seeded per call
-    zs = _sample_z(rng, n, precheck)
-
     # A-lane scalars mod 8L (exact for points of any order; kills torsion
     # since z ≡ 0 mod 8 survives the reduction), B-lane scalar mod L.
-    w_scalars = [zs[i] * hk_ints[i] % L8 if precheck[i] else 0 for i in range(n)]
-    u = sum(zs[i] * s_ints[i] for i in range(n) if precheck[i]) % L
+    zs, w_scalars, u = _rlc_scalars(precheck, s_ints, hk_ints, n)
 
     b_enc = np.frombuffer(point_compress(BASE), dtype=np.uint8)
     na = _lane_bucket(n + 1)
@@ -546,14 +570,14 @@ def _verify_batch_rlc(
 # (observability + tests).
 LAST_JAX_PATH: list = [""]
 
-_SHARDED_RUNNER = None  # cached (n_devices, run_fn)
+_SHARDED_RUNNER = None  # cached (n_devices, persig_run, rlc_run)
 
 
-def _sharded_runner():
-    """Production multi-chip path: when >1 jax device is visible, shard the
-    per-signature kernel's batch axis across a 1D mesh (parallel/sharded.py).
-    Uses the largest power-of-two device count so power-of-two shape buckets
-    always divide evenly. Returns None on single-device hosts."""
+def _sharded_env():
+    """Production multi-chip path: when >1 jax device is visible, shard
+    across a 1D mesh (parallel/sharded.py). Uses the largest power-of-two
+    device count so power-of-two shape buckets always divide evenly.
+    Returns (n_devices, persig_run, rlc_run) or None on single-device hosts."""
     global _SHARDED_RUNNER
     knob = os.environ.get("TMTPU_SHARDED", "auto")
     if knob == "0":
@@ -570,13 +594,78 @@ def _sharded_runner():
     if nd < 2:
         return None
     if _SHARDED_RUNNER is not None and _SHARDED_RUNNER[0] == nd:
-        return _SHARDED_RUNNER[1]
-    from tendermint_tpu.parallel.sharded import make_mesh, sharded_verify
+        return _SHARDED_RUNNER
+    from tendermint_tpu.parallel.sharded import (
+        make_mesh,
+        sharded_rlc_check,
+        sharded_verify,
+    )
 
     mesh = make_mesh(devs[:nd], axis_names=("vals",))
-    run = sharded_verify(mesh)
-    _SHARDED_RUNNER = (nd, run)
-    return run
+    _SHARDED_RUNNER = (nd, sharded_verify(mesh), sharded_rlc_check(mesh))
+    return _SHARDED_RUNNER
+
+
+def _sharded_runner():
+    env = _sharded_env()
+    return env[1] if env is not None else None
+
+
+def _verify_batch_rlc_sharded(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> Optional[np.ndarray]:
+    """Multi-chip RLC fast path: ONE combined Pippenger check with lanes
+    sharded across the mesh (parallel/sharded.sharded_rlc_check) — each chip
+    runs a partial MSM over its lane shard, partial points are all-gathered
+    over ICI and summed. ~10x less per-chip work than the sharded per-sig
+    ladder. Returns the mask, or None -> per-sig sharded fallback."""
+    from tendermint_tpu.crypto.ed25519_ref import BASE, point_compress
+    from tendermint_tpu.parallel.sharded import prepare_rlc_shards
+
+    env = _sharded_env()
+    if env is None:
+        return None
+    nd, _, rlc_run = env
+    n = len(pubkeys)
+    precheck, a_rows, r_rows, s_ints, hk_ints = _precheck_and_hash(pubkeys, msgs, sigs)
+    zs, w_scalars, u = _rlc_scalars(precheck, s_ints, hk_ints, n)
+
+    # NOTE: no decoded-pubkey cache on this path yet — every height
+    # re-decodes A in-kernel (acceptable: this path only runs on multi-chip
+    # hosts, which this environment cannot exercise beyond the dryrun); a
+    # cached-A sharded variant is the natural next step.
+    na = _lane_bucket(n + 1)
+    while (2 * na) % nd:
+        na += 1
+    b_enc = np.frombuffer(point_compress(BASE), dtype=np.uint8)
+    pts = np.tile(b_enc, (2 * na, 1))
+    if precheck.any():
+        pts[:n][precheck] = a_rows[precheck]
+        pts[na : na + n][precheck] = r_rows[precheck]
+    scalars = [0] * (2 * na)
+    scalars[:n] = w_scalars
+    scalars[n] = (L - u) % L
+    scalars[na : na + n] = [zs[i] if precheck[i] else 0 for i in range(n)]
+
+    try:
+        bok, ok = rlc_run(*prepare_rlc_shards(pts, scalars, nd))
+    except Exception:
+        import logging
+
+        logging.getLogger("tendermint_tpu.crypto.batch").exception(
+            "sharded RLC failed; falling back to sharded per-signature"
+        )
+        return None
+    ok = np.asarray(ok)
+    lanes_ok = (
+        bool(ok[:n][precheck].all() and ok[na : na + n][precheck].all())
+        if precheck.any()
+        else True
+    )
+    if bool(np.asarray(bok)) and lanes_ok:
+        LAST_JAX_PATH[0] = "rlc-sharded"
+        return precheck
+    return None
 
 
 def verify_batch_jax(
@@ -585,11 +674,16 @@ def verify_batch_jax(
     from tendermint_tpu.ops.ed25519_jax import verify_prepared
 
     sharded = _sharded_runner()
-    if sharded is None and _rlc_enabled() and len(pubkeys) >= RLC_MIN:
-        mask = _verify_batch_rlc(pubkeys, msgs, sigs)
-        if mask is not None:
-            LAST_JAX_PATH[0] = "rlc"
-            return mask
+    if _rlc_enabled() and len(pubkeys) >= RLC_MIN:
+        if sharded is not None:
+            mask = _verify_batch_rlc_sharded(pubkeys, msgs, sigs)
+            if mask is not None:
+                return mask  # LAST_JAX_PATH set to "rlc-sharded"
+        else:
+            mask = _verify_batch_rlc(pubkeys, msgs, sigs)
+            if mask is not None:
+                LAST_JAX_PATH[0] = "rlc"
+                return mask
         # Combined check failed: at least one signature is bad (or an
         # encoding was invalid) — recover the exact per-signature mask.
     a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
@@ -600,6 +694,108 @@ def verify_batch_jax(
         LAST_JAX_PATH[0] = "persig"
         mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
     return mask & precheck
+
+
+def _verify_batch_mixed_exact(
+    pubkeys, msgs, sigs, key_types, backend=None
+) -> np.ndarray:
+    """Exact per-type routing for mixed sets: ed25519 rows through the
+    selected backend, sr25519 rows through the host schnorrkel path, any
+    unknown type False."""
+    from tendermint_tpu.crypto.sr25519 import sr25519_verify
+
+    out = np.zeros(len(pubkeys), dtype=bool)
+    ed_idx = [i for i, t in enumerate(key_types) if t == "ed25519"]
+    sr_idx = [i for i, t in enumerate(key_types) if t == "sr25519"]
+    if ed_idx:
+        sub = verify_batch(
+            [pubkeys[i] for i in ed_idx],
+            [msgs[i] for i in ed_idx],
+            [sigs[i] for i in ed_idx],
+            backend,
+        )
+        out[ed_idx] = sub
+    for i in sr_idx:
+        out[i] = sr25519_verify(bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i]))
+    return out
+
+
+class BatchHandle:
+    """An in-flight verify_batch: device work submitted, not yet synced.
+    Lets independent verification sites (e.g. the light client's
+    trusting+light pair, reference light/verifier.go:32) overlap their
+    device round trips instead of paying one each, serially."""
+
+    __slots__ = ("_mask", "_call", "_args")
+
+    def __init__(self, mask=None, call=None, args=None):
+        self._mask = mask
+        self._call = call
+        self._args = args
+
+
+def verify_batch_submit(
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    backend: str | None = None,
+    key_types: Sequence[str] | None = None,
+) -> BatchHandle:
+    """Start a batch verification; pair with verify_batch_finish. RLC-eligible
+    batches return with device work merely SUBMITTED (JAX async dispatch) so
+    multiple submits queue back-to-back on device; anything else computes
+    eagerly inside the handle."""
+    be = backend or backend_default()
+    mixed = key_types is not None and any(t != "ed25519" for t in key_types)
+    eligible = (
+        be == "jax"
+        and _rlc_enabled()
+        and len(pubkeys) >= max(RLC_MIN, _JAX_MIN_BATCH if backend is None else 0)
+        and _sharded_runner() is None
+        and (not mixed or all(t in ("ed25519", "sr25519") for t in (key_types or [])))
+        and len(pubkeys) > 0
+    )
+    if not eligible:
+        return BatchHandle(
+            mask=verify_batch(pubkeys, msgs, sigs, backend, key_types)
+        )
+    try:
+        call = _rlc_submit(pubkeys, msgs, sigs, key_types if mixed else None)
+    except Exception:
+        import logging
+
+        logging.getLogger("tendermint_tpu.crypto.batch").exception(
+            "RLC submit failed; falling back to synchronous verification"
+        )
+        return BatchHandle(mask=verify_batch(pubkeys, msgs, sigs, backend, key_types))
+    return BatchHandle(call=call, args=(pubkeys, msgs, sigs, backend, key_types, mixed))
+
+
+def verify_batch_finish(h: BatchHandle) -> np.ndarray:
+    if h._mask is not None:
+        return h._mask
+    pubkeys, msgs, sigs, backend, key_types, mixed = h._args
+    try:
+        mask = _rlc_finish(h._call)
+    except Exception:
+        import logging
+
+        logging.getLogger("tendermint_tpu.crypto.batch").exception(
+            "RLC finish failed; falling back to exact verification"
+        )
+        mask = None
+    if mask is not None:
+        h._mask = mask
+        return mask
+    # combined check failed (or errored): recover the exact per-row mask
+    if mixed:
+        h._mask = _verify_batch_mixed_exact(pubkeys, msgs, sigs, key_types, backend)
+    else:
+        from tendermint_tpu.ops.ed25519_jax import verify_prepared
+
+        a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
+        h._mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n] & precheck
+    return h._mask
 
 
 def verify_batch(
@@ -643,20 +839,7 @@ def verify_batch(
             if mask is not None:
                 LAST_JAX_PATH[0] = "rlc-mixed"
                 return mask
-        out = np.zeros(len(pubkeys), dtype=bool)
-        ed_idx = [i for i, t in enumerate(key_types) if t == "ed25519"]
-        sr_idx = [i for i, t in enumerate(key_types) if t == "sr25519"]
-        if ed_idx:
-            sub = verify_batch(
-                [pubkeys[i] for i in ed_idx],
-                [msgs[i] for i in ed_idx],
-                [sigs[i] for i in ed_idx],
-                backend,
-            )
-            out[ed_idx] = sub
-        for i in sr_idx:
-            out[i] = sr25519_verify(bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i]))
-        return out
+        return _verify_batch_mixed_exact(pubkeys, msgs, sigs, key_types, backend)
     be = backend or backend_default()
     # Auto-selected jax falls back to the host loop for tiny batches: a
     # handful of signatures is faster on CPU than one device round-trip
@@ -670,6 +853,52 @@ def verify_batch(
     if be == "jax":
         return verify_batch_jax(pubkeys, msgs, sigs)
     raise ValueError(f"unknown crypto backend {be!r}")
+
+
+def prewarm(
+    n_vals: int,
+    backend: str | None = None,
+    pubkeys: Sequence[bytes] | None = None,
+) -> None:
+    """Compile (or load from the persistent cache) the kernels a node with an
+    n_vals validator set will hit: the plain RLC kernel (first sight of a
+    key), the cached-A RLC kernel (steady state), and — by routing through
+    verify_batch_jax — the sharded variants on multi-device hosts. When the
+    node's REAL validator pubkeys are provided, their decoded coordinates are
+    also pre-filled into the A cache so the very first consensus flush takes
+    the steady-state path.
+
+    Called from node startup in a BACKGROUND thread (node/node.py) so a node
+    cold-starting into a vote storm doesn't stall consensus for the first
+    compile: jit compilation holds a per-executable lock, so a consensus
+    flush that arrives mid-prewarm blocks until the compile finishes instead
+    of compiling again. The throwaway signing key is random (os.urandom), so
+    nothing derivable ever enters the cache."""
+    be = backend or backend_default()
+    if be != "jax" or n_vals < _JAX_MIN_BATCH:
+        return  # small valsets ride the host loop; nothing to compile
+    from tendermint_tpu.crypto.keys import gen_ed25519
+
+    priv = gen_ed25519()
+    pk = priv.pub_key().bytes()
+    msg = b"prewarm"
+    sig = priv.sign(msg)
+    dummy = [pk] * n_vals
+    msgs = [msg] * n_vals
+    sigs = [sig] * n_vals
+    # 1st call: A cache cold for the dummy key -> PLAIN kernel (the variant
+    # the first sight of any new validator set runs); fills the dummy entry.
+    verify_batch_jax(dummy, msgs, sigs)
+    # 2nd call: cache hit -> CACHED-A kernel (the steady-state variant).
+    verify_batch_jax(dummy, msgs, sigs)
+    if pubkeys:
+        # decode the real validator keys so consensus's first flush is a
+        # cache hit (this is the exact decode steady state amortizes away)
+        rows = np.stack(
+            [np.frombuffer(bytes(k), dtype=np.uint8) for k in pubkeys if len(k) == 32]
+        )
+        if len(rows):
+            _fill_a_cache(rows)
 
 
 class Ed25519BatchVerifier:

@@ -121,8 +121,22 @@ def verify_non_adjacent(
         raise ErrOldHeaderExpired(trusted.header.time_ns + trusting_period_ns, now_ns)
     _verify_new_header_and_vals(untrusted, untrusted_vals, trusted, now_ns, max_clock_drift_ns)
 
+    # PIPELINED: submit both batch verifications before syncing either — the
+    # trusting-set and new-set checks are independent device calls, so their
+    # round trips overlap instead of paying 2 serial RTTs (the reference
+    # runs them serially, light/verifier.go:56,80).
     try:
-        trusted_next_vals.verify_commit_light_trusting(chain_id, untrusted.commit, trust_level)
+        fin_trusting = trusted_next_vals.begin_verify_commit_light_trusting(
+            chain_id, untrusted.commit, trust_level
+        )
+        fin_light = untrusted_vals.begin_verify_commit_light(
+            chain_id, untrusted.commit.block_id, untrusted.height, untrusted.commit
+        )
+    except CommitVerifyError as e:
+        raise ErrInvalidHeader(f"invalid commit: {e}") from e
+
+    try:
+        fin_trusting()
     except NotEnoughVotingPowerError as e:
         # recoverable: the caller should bisect (reference: light/verifier.go:73)
         raise ErrNewValSetCantBeTrusted(str(e)) from e
@@ -131,9 +145,7 @@ def verify_non_adjacent(
         raise ErrInvalidHeader(f"invalid commit: {e}") from e
 
     try:
-        untrusted_vals.verify_commit_light(
-            chain_id, untrusted.commit.block_id, untrusted.height, untrusted.commit
-        )
+        fin_light()
     except CommitVerifyError as e:
         raise ErrInvalidHeader(f"invalid commit: {e}") from e
 

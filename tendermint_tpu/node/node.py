@@ -280,6 +280,7 @@ class Node:
 
     async def start(self) -> None:
         self._running = True
+        self._start_crypto_prewarm()
         await self.indexer_service.start()
         if not (self.switch is not None and (self.fast_sync or self.state_sync)):
             # with fast/state sync active, consensus starts at the blocksync
@@ -363,6 +364,36 @@ class Node:
         addr = laddr.split("://", 1)[-1]
         host, _, port = addr.rpartition(":")
         return host or "127.0.0.1", int(port)
+
+    def _start_crypto_prewarm(self) -> None:
+        """Compile the steady-state verification kernels for THIS chain's
+        validator-set size in a daemon thread (crypto/batch.prewarm): a node
+        cold-starting into a vote storm must not stall its receive loop on a
+        first-call kernel compile (round-3 finding: minutes per shape)."""
+        import threading
+
+        from tendermint_tpu.crypto import batch as _batch
+
+        try:
+            vals = self.consensus.rs.validators
+            n_vals = vals.size()
+            pubkeys = [v.pub_key.bytes() for v in vals.validators]
+        except Exception:
+            n_vals, pubkeys = 0, None
+        if n_vals <= 0 or _batch.backend_default() != "jax":
+            return
+
+        def run():
+            try:
+                _batch.prewarm(n_vals, pubkeys=pubkeys)
+            except Exception:  # prewarm is best-effort; first caller compiles
+                import logging
+
+                logging.getLogger("tendermint_tpu.node").exception(
+                    "crypto kernel prewarm failed"
+                )
+
+        threading.Thread(target=run, name="crypto-prewarm", daemon=True).start()
 
     async def stop(self) -> None:
         self._running = False

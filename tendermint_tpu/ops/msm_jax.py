@@ -122,9 +122,22 @@ def _neg(C: SmallCtx, a):
     return _sub(C, jnp.zeros_like(a), a)
 
 
+def _use_pallas() -> bool:
+    from tendermint_tpu.ops import pallas_fe
+
+    return pallas_fe.enabled()
+
+
 def _padd(C: SmallCtx, p: Point, q: Point) -> Point:
     """Unified a=-1 extended add (same formula as ed25519_jax.point_add but
-    with rank-agnostic constants)."""
+    with rank-agnostic constants). On TPU this routes through the fused
+    Pallas kernel (ops/pallas_fe.py) — ~11x the XLA fusion's field-mul
+    throughput (the XLA conv churns its accumulator through HBM) and one
+    custom call instead of ~500 HLO ops per add."""
+    if _use_pallas():
+        from tendermint_tpu.ops import pallas_fe
+
+        return pallas_fe.padd(p, q)
     a = fe.mul(_sub(C, p.y, p.x), _sub(C, q.y, q.x))
     b = fe.mul(fe.add(p.y, p.x), fe.add(q.y, q.x))
     c = fe.mul(fe.mul(p.t, q.t), _rs(C.d2, p.t.ndim))
@@ -137,6 +150,10 @@ def _padd(C: SmallCtx, p: Point, q: Point) -> Point:
 
 
 def _pdbl(C: SmallCtx, p: Point) -> Point:
+    if _use_pallas():
+        from tendermint_tpu.ops import pallas_fe
+
+        return pallas_fe.pdbl(p)
     xx = fe.square(p.x)
     yy = fe.square(p.y)
     zz2 = fe.mul_small(fe.square(p.z), 2)
@@ -146,6 +163,23 @@ def _pdbl(C: SmallCtx, p: Point) -> Point:
     f = _sub(C, g, zz2)
     h = _neg(C, fe.add(xx, yy))
     return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def _pdbl_n(C: SmallCtx, p: Point, n: int) -> Point:
+    """[2^n] p. On TPU, doublings fuse into Pallas kernels in runs of 8
+    (single-kernel chains longer than ~8 blow up Mosaic compile time for no
+    runtime gain); elsewhere a plain unrolled loop."""
+    if _use_pallas():
+        from tendermint_tpu.ops import pallas_fe
+
+        while n > 0:
+            k = min(n, 8)
+            p = pallas_fe.pdbl(p, times=k)
+            n -= k
+        return p
+    for _ in range(n):
+        p = _pdbl(C, p)
+    return p
 
 
 def _pneg(C: SmallCtx, p: Point) -> Point:
@@ -403,9 +437,7 @@ def _weighted_bucket_sum(C: SmallCtx, prefix: Point) -> Point:
 
     # [255] P_255 = [256] P_255 - P_255: 8 doublings + one add of the negation.
     if not _scan_structures():
-        m = p_last
-        for _ in range(v_max.bit_length()):
-            m = _pdbl(C, m)
+        m = _pdbl_n(C, p_last, v_max.bit_length())
     else:
         def dbl_body(st, _):
             return tuple(_pdbl(C, Point(*st))), None
@@ -417,20 +449,42 @@ def _weighted_bucket_sum(C: SmallCtx, prefix: Point) -> Point:
 
 
 def _combine_windows(C: SmallCtx, w_pts: Point) -> Point:
-    """w_pts coords (20, T) with window w weight 256^w. Horner from MSB.
+    """w_pts coords (20, T) with window w weight 256^w -> sum [256^w] W_w.
 
     The ~248-doubling sequential depth is inherent (it equals the scalar
-    bit-width); restructuring it (unrolled, pairwise-split) measured no
-    faster on TPU and blew up XLA:CPU compile memory, so the compile-sized
-    nested-loop form stays."""
+    bit-width), but HOW it is scheduled matters enormously on TPU: the
+    round-3 Horner (lax.scan over 31 window steps) measured ~64 ms at 10k —
+    ~2 ms/iteration of while-loop overhead on width-1 tensors, a third of
+    total kernel time. The Pallas form is an unrolled pairwise fold:
+        level k: V_i = U_{2i} + [2^(8*2^k)] U_{2i+1}
+    — same 248 sequential doublings, but zero loop machinery, shrinking
+    widths (16, 8, 4, 2, 1), and each point-op ONE custom call so the graph
+    stays ~300 HLO ops. The fold is PALLAS-ONLY: expressed in raw jnp its
+    ~253 point-ops inline to >15k HLO and the XLA:TPU compile ran >30 min
+    before being killed (XLA:CPU dies the same way) — scan stays the
+    non-pallas form on both backends."""
     t_ = w_pts.x.shape[-1]
+    if _use_pallas():
+        p = w_pts
+        shift = WINDOW_BITS
+        while p.x.shape[-1] > 1:
+            w = p.x.shape[-1]
+            if w % 2 == 1:
+                p = _pad_lanes(C, p, w + 1)
+            even = Point(*(a[..., 0::2] for a in p))
+            odd = Point(*(a[..., 1::2] for a in p))
+            odd = _pdbl_n(C, odd, shift)
+            p = _padd(C, even, odd)
+            shift *= 2
+        return Point(*(a[..., 0] for a in p))
+
     acc = Point(*(a[..., t_ - 1] for a in w_pts))  # (20,)
     xs = jnp.stack(
         [jnp.moveaxis(a[..., : t_ - 1], -1, 0) for a in w_pts], axis=1
     )  # (T-1, 4, 20)
     xs = xs[::-1]  # MSB-first over remaining windows
 
-    unroll_dbl = not _scan_structures()
+    unroll_dbl = not _scan_structures()  # TPU: unrolled dblings inside body
 
     def body(acc_coords, wp):
         if unroll_dbl:
@@ -460,18 +514,26 @@ def _window_points(C: SmallCtx, pts: Point, perm, node_idx) -> Point:
     return _weighted_bucket_sum(C, prefix)  # (20, T)
 
 
-def _msm_is_identity(C: SmallCtx, pts: Point, perm, node_idx) -> jnp.ndarray:
-    """pts: decompressed valid points (20, N); perm (T, N). Returns scalar
-    bool: MSM == identity. (A window-split variant — high windows over the
-    A block only, since R-lane coefficients are < 2^128 — was tried and
-    measured 4x SLOWER on TPU: two half-width pipelines lose to one fused
-    full-width one.)"""
+def _msm_total(C: SmallCtx, pts: Point, perm, node_idx) -> Point:
+    """pts: decompressed valid points (20, N); perm (T, N). Returns the full
+    multiscalar sum as a single point (20,). (A window-split variant — high
+    windows over the A block only, since R-lane coefficients are < 2^128 —
+    was tried and measured 4x SLOWER on TPU: two half-width pipelines lose
+    to one fused full-width one.)"""
     w_pts = _window_points(C, pts, perm, node_idx)  # (20, T)
-    total = _combine_windows(C, w_pts)  # (20,)
-    # z != 0 guard: an exceptional unified addition (possible only on
-    # crafted torsion inputs) yields (0,0,0,0), which must read as
-    # "check failed" (-> per-sig fallback), not as the identity.
+    return _combine_windows(C, w_pts)  # (20,)
+
+
+def point_is_identity(C: SmallCtx, total: Point) -> jnp.ndarray:
+    """Projective identity check with the degenerate-output guard: an
+    exceptional unified addition (possible only on crafted torsion inputs)
+    yields (0,0,0,0), which must read as "check failed" (-> per-sig
+    fallback), not as the identity."""
     return fe.is_zero(total.x) & fe.eq(total.y, total.z) & ~fe.is_zero(total.z)
+
+
+def _msm_is_identity(C: SmallCtx, pts: Point, perm, node_idx) -> jnp.ndarray:
+    return point_is_identity(C, _msm_total(C, pts, perm, node_idx))
 
 
 def _rlc_core(

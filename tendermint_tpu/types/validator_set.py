@@ -353,9 +353,14 @@ class ValidatorSet:
         if tallied <= needed:
             raise NotEnoughVotingPowerError(tallied, needed)
 
-    def verify_commit_light(self, chain_id: str, block_id, height: int, commit) -> None:
-        """Only for-block signatures verified, batched; valid tally must exceed
-        2/3 (reference: types/validator_set.go:719-763)."""
+    def begin_verify_commit_light(self, chain_id: str, block_id, height: int, commit):
+        """Submit-phase of verify_commit_light: structural checks + device
+        submit; returns a finish() callable that syncs, tallies, and raises
+        on failure. Lets callers overlap several independent commit
+        verifications' device round trips (light/verifier.py pipelines the
+        trusting+light pair this way)."""
+        from tendermint_tpu.crypto.batch import verify_batch_finish, verify_batch_submit
+
         if self.size() != len(commit.signatures):
             raise CommitVerifyError(
                 f"invalid commit -- wrong set size: {self.size()} vs {len(commit.signatures)}"
@@ -377,17 +382,29 @@ class ValidatorSet:
             sigs.append(cs.signature)
             powers.append(val.voting_power)
             key_types.append(val.pub_key.type_name())
-        mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
-        tallied = sum(p for ok, p in zip(mask, powers) if ok)
-        needed = self.total_voting_power() * 2 // 3
-        if tallied <= needed:
-            raise NotEnoughVotingPowerError(tallied, needed)
+        handle = verify_batch_submit(pubkeys, msgs, sigs, key_types=key_types)
 
-    def verify_commit_light_trusting(
+        def finish() -> None:
+            mask = verify_batch_finish(handle)
+            tallied = sum(p for ok, p in zip(mask, powers) if ok)
+            needed = self.total_voting_power() * 2 // 3
+            if tallied <= needed:
+                raise NotEnoughVotingPowerError(tallied, needed)
+
+        return finish
+
+    def verify_commit_light(self, chain_id: str, block_id, height: int, commit) -> None:
+        """Only for-block signatures verified, batched; valid tally must exceed
+        2/3 (reference: types/validator_set.go:719-763)."""
+        self.begin_verify_commit_light(chain_id, block_id, height, commit)()
+
+    def begin_verify_commit_light_trusting(
         self, chain_id: str, commit, trust_level: Fraction
-    ) -> None:
-        """Trust-level verification against a possibly different validator set
-        (reference: types/validator_set.go:772-830)."""
+    ):
+        """Submit-phase of verify_commit_light_trusting; see
+        begin_verify_commit_light."""
+        from tendermint_tpu.crypto.batch import verify_batch_finish, verify_batch_submit
+
         if trust_level.denominator == 0:
             raise CommitVerifyError("trustLevel has zero Denominator")
         total_mul = self.total_voting_power() * trust_level.numerator
@@ -411,7 +428,19 @@ class ValidatorSet:
             sigs.append(cs.signature)
             powers.append(val.voting_power)
             key_types.append(val.pub_key.type_name())
-        mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
-        tallied = sum(p for ok, p in zip(mask, powers) if ok)
-        if tallied <= needed:
-            raise NotEnoughVotingPowerError(tallied, needed)
+        handle = verify_batch_submit(pubkeys, msgs, sigs, key_types=key_types)
+
+        def finish() -> None:
+            mask = verify_batch_finish(handle)
+            tallied = sum(p for ok, p in zip(mask, powers) if ok)
+            if tallied <= needed:
+                raise NotEnoughVotingPowerError(tallied, needed)
+
+        return finish
+
+    def verify_commit_light_trusting(
+        self, chain_id: str, commit, trust_level: Fraction
+    ) -> None:
+        """Trust-level verification against a possibly different validator set
+        (reference: types/validator_set.go:772-830)."""
+        self.begin_verify_commit_light_trusting(chain_id, commit, trust_level)()

@@ -1,5 +1,9 @@
 """Differential tests: JAX batched ed25519 vs pure-python RFC 8032 reference."""
 
+import pytest
+
+pytestmark = pytest.mark.kernel  # heavy compiles; fast lane: -m 'not kernel'
+
 import numpy as np
 
 from tendermint_tpu.crypto import batch as cbatch

@@ -1,5 +1,9 @@
 """Differential tests: JAX GF(2^255-19) kernel vs Python big-int arithmetic."""
 
+import pytest
+
+pytestmark = pytest.mark.kernel  # heavy compiles; fast lane: -m 'not kernel'
+
 import numpy as np
 
 from tendermint_tpu.ops import fe25519 as fe

@@ -12,6 +12,10 @@ Shapes are kept to the production lane buckets (Na=64 -> 128 lanes) so the
 persistent compile cache is shared with real use.
 """
 
+import pytest
+
+pytestmark = pytest.mark.kernel  # heavy compiles; fast lane: -m 'not kernel'
+
 import os
 
 import numpy as np

@@ -1,0 +1,143 @@
+"""Differential tests for the Pallas fused point kernels (ops/pallas_fe.py).
+
+The kernel BODY (row-list field/point math) is plain jnp code — validated
+here directly against the pure-python reference on the CPU backend, at the
+exact (S, 128) row shapes the kernels use. The pallas_call plumbing
+(BlockSpec tiling, lane padding) is shape-only; its pack/unpack inverse is
+tested host-side, and the compiled path is exercised on real TPU by the
+MSM fast path (bench.py, tools/profile_msm.py). Mosaic interpret mode is
+NOT used: interpreting the ~6k-op kernel body through XLA:CPU compiles for
+minutes (measured)."""
+
+import pytest
+
+pytestmark = pytest.mark.kernel  # heavy compiles; fast lane: -m 'not kernel'
+
+import numpy as np
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.ops import fe25519 as fe
+from tendermint_tpu.ops import pallas_fe as pf
+from tendermint_tpu.ops.ed25519_jax import Point
+
+rng = np.random.default_rng(99)
+S, L = 1, 128  # one row tile: 128 lanes
+
+
+def to_rows(ints):
+    """List of python ints -> row-list of (S, 128) arrays (lane i = ints[i],
+    rest replicated from lane 0 to keep every lane a valid field element)."""
+    limbs = np.stack([fe.from_int(x) for x in ints], axis=-1)  # (20, n)
+    rows = []
+    for i in range(pf.NL):
+        buf = np.full((S, L), limbs[i, 0], dtype=np.int32)
+        buf.flat[: len(ints)] = limbs[i]
+        rows.append(buf)
+    return [np.asarray(r) for r in rows]
+
+
+def rows_to_int(rows, lane=0):
+    return fe.to_int(np.asarray([np.asarray(r).flat[lane] for r in rows]))
+
+
+def rand_fe(n):
+    return [int.from_bytes(rng.bytes(32), "little") % fe.P for x in range(n)]
+
+
+def test_row_field_ops_match_reference():
+    xs, ys = rand_fe(8), rand_fe(8)
+    rx, ry = to_rows(xs), to_rows(ys)
+    for name, got_rows, want_fn in [
+        ("mul", pf._rmul(rx, ry), lambda a, b: a * b % fe.P),
+        ("add", pf._radd(rx, ry), lambda a, b: (a + b) % fe.P),
+        ("sub", pf._rsub(rx, ry), lambda a, b: (a - b) % fe.P),
+        ("square", pf._rsquare(rx), lambda a, b: a * a % fe.P),
+        ("mul_small", pf._rmul_small(rx, 2), lambda a, b: 2 * a % fe.P),
+        ("mul_const_d2", pf._rmul_const(rx, pf._D2), lambda a, b: a * fe.D2 % fe.P),
+    ]:
+        for i in range(8):
+            assert rows_to_int(got_rows, i) == want_fn(xs[i], ys[i]), (name, i)
+
+
+def test_row_mul_bounds_chain():
+    """Chained muls stay in the carried representation (no int32 overflow):
+    64 dependent multiplies match pow arithmetic."""
+    x = rand_fe(1)[0]
+    acc_rows = to_rows([x])
+    acc = x
+    for _ in range(64):
+        acc_rows = pf._rmul(acc_rows, acc_rows)
+        acc = acc * acc % fe.P
+        for r in acc_rows:
+            arr = np.asarray(r)
+            assert arr.min() >= 0 and arr.max() < (1 << 14), "limb out of range"
+    assert rows_to_int(acc_rows) == acc
+
+
+def rand_points(n):
+    return [
+        ref.point_mul(int.from_bytes(rng.bytes(32), "little") % ref.L, ref.BASE)
+        for _ in range(n)
+    ]
+
+
+def pt_to_rows(pts):
+    return tuple(to_rows([p[c] for p in pts]) for c in range(4))
+
+
+def rows_to_pt(coords, lane=0):
+    return tuple(rows_to_int(r, lane) for r in coords)
+
+
+def test_row_point_add_matches_reference():
+    ps, qs = rand_points(6), rand_points(6)
+    out = pf._padd_rows(pt_to_rows(ps), pt_to_rows(qs))
+    for i in range(6):
+        got = rows_to_pt(out, i)
+        want = ref.point_add(ps[i], qs[i])
+        assert ref.point_equal(got, want), i
+        x, y, z, t = got
+        assert (x * y - t * z) % ref.P == 0
+
+
+def test_row_point_add_identity():
+    ps = rand_points(2)
+    ident = (0, 1, 1, 0)
+    out = pf._padd_rows(pt_to_rows([ps[0], ident]), pt_to_rows([ident, ident]))
+    assert ref.point_equal(rows_to_pt(out, 0), ps[0])
+    assert ref.point_equal(rows_to_pt(out, 1), ident)
+
+
+def test_row_point_double_matches_reference():
+    ps = rand_points(5)
+    out = pf._pdbl_rows(pt_to_rows(ps))
+    for i in range(5):
+        assert ref.point_equal(rows_to_pt(out, i), ref.point_double(ps[i]))
+
+
+def test_row_point_double_chain_8():
+    ps = rand_points(3)
+    coords = pt_to_rows(ps)
+    for _ in range(8):
+        coords = pf._pdbl_rows(coords)
+    for i in range(3):
+        want = ps[i]
+        for _ in range(8):
+            want = ref.point_double(want)
+        assert ref.point_equal(rows_to_pt(coords, i), want)
+
+
+def test_pack_unpack_roundtrip():
+    """_pack pads lanes to 128-multiples and tiles; _unpack inverts exactly —
+    including non-multiple and multi-dim batch shapes."""
+    for shape in [(9,), (128,), (130,), (2, 3), (32, 5)]:
+        coords = [
+            rng.integers(0, 1 << 13, (fe.NLIMBS, *shape)).astype(np.int32)
+            for _ in range(4)
+        ]
+        p = Point(*coords)
+        packed, bs, n = pf._pack(p)
+        assert packed.shape[0] == 4 and packed.shape[3] == 128
+        back = pf._unpack(np.asarray(packed), bs, n)
+        for a, b in zip(p, back):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -3,6 +3,10 @@ host implementation (crypto/sr25519.py), including invalid and edge
 encodings. Reference semantics: crypto/sr25519/pubkey.go:34 (go-schnorrkel
 -> ristretto255 decode)."""
 
+import pytest
+
+pytestmark = pytest.mark.kernel  # heavy compiles; fast lane: -m 'not kernel'
+
 import numpy as np
 
 from tendermint_tpu.crypto.ed25519_ref import BASE, P, point_mul

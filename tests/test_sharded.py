@@ -3,6 +3,10 @@ masks must match single-device results exactly
 (the dryrun in __graft_entry__ covers sharded_commit_step; this covers
 sharded_verify and the 2D mesh layout)."""
 
+import pytest
+
+pytestmark = pytest.mark.kernel  # heavy compiles; fast lane: -m 'not kernel'
+
 import os
 
 import numpy as np
@@ -76,4 +80,36 @@ def test_verify_batch_routes_through_mesh(monkeypatch):
     assert B.LAST_JAX_PATH[0] == "sharded"
     assert mask.sum() == n - 2 and not mask[3] and not mask[n - 1]
     monkeypatch.setenv("TMTPU_SHARDED", "0")
+    B._SHARDED_RUNNER = None
+
+
+def test_sharded_rlc_check_all_valid_and_fallback(monkeypatch):
+    """The RLC/Pippenger fast path sharded over the mesh (r3 verdict item 5):
+    all-valid batches pass the combined check with lanes split across 8
+    devices ("rlc-sharded" path, no fallback); a bad signature fails the
+    combined check and recovers the exact mask via the sharded per-sig
+    kernel. Cross-chip traffic is one all_gather of partial points."""
+    from tendermint_tpu.crypto import batch as B
+
+    if len(jax.devices("cpu")) < 8:
+        pytest.skip("needs 8 virtual devices")
+    monkeypatch.setenv("TMTPU_SHARDED", "1")
+    monkeypatch.setattr(B, "_SHARDED_RUNNER", None)
+    monkeypatch.setattr(B, "RLC_MIN", 1)
+    n = 24
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        priv = gen_ed25519(bytes([i % 250 + 1]) * 32)
+        m = b"rlc-shard-%04d" % i
+        pubs.append(priv.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(priv.sign(m))
+    mask = B.verify_batch_jax(pubs, msgs, sigs)
+    assert B.LAST_JAX_PATH[0] == "rlc-sharded"
+    assert mask.all() and len(mask) == n
+    # one bad signature -> combined check fails -> exact sharded mask
+    sigs[5] = sigs[5][:7] + bytes([sigs[5][7] ^ 1]) + sigs[5][8:]
+    mask = B.verify_batch_jax(pubs, msgs, sigs)
+    assert B.LAST_JAX_PATH[0] == "sharded"
+    assert not mask[5] and mask.sum() == n - 1
     B._SHARDED_RUNNER = None
