@@ -231,7 +231,7 @@ def bench_streaming(n: int, batches: int = 6):
     assert warm is not None and warm.all()
     t0 = time.perf_counter()
     calls = [B._rlc_submit(pubkeys, msgs, sigs) for _ in range(batches)]
-    masks = [B._rlc_finish(c) for c in calls]
+    masks = B._rlc_finish_many(calls)
     dt = time.perf_counter() - t0
     for m in masks:
         assert m is not None and m.all()
@@ -277,7 +277,7 @@ def bench_fastsync_replay(n_blocks: int = 16, n_vals: int = 1024):
     assert m0 is not None and m0.all()
     t0 = time.perf_counter()
     calls = [B._rlc_submit(pks, per_block[i], per_block_sigs[i]) for i in range(n_blocks)]
-    masks = [B._rlc_finish(c) for c in calls]
+    masks = B._rlc_finish_many(calls)
     dt = time.perf_counter() - t0
     for m in masks:
         assert m is not None and m.all()

@@ -607,6 +607,18 @@ def main(argv=None) -> int:
     sp.add_argument("--rpc", default="", help="RPC URL of the running node (optional)")
     sp.add_argument("--output", default="debug_dump.zip")
 
+    sp = sub.add_parser(
+        "abci", help="abci-cli console: drive an ABCI app (conformance tool)"
+    )
+    sp.add_argument(
+        "--app", default="kvstore",
+        help="kvstore | persistent_kvstore | counter | counter:noserial | tcp://host:port",
+    )
+    sp.add_argument(
+        "batch_file", nargs="?", default=None,
+        help="command script (one command per line); stdin console if omitted",
+    )
+
     sp = sub.add_parser("light", help="light client: verify headers over RPC")
     sp.add_argument("chain_id")
     sp.add_argument("--primary", required=True, help="primary RPC URL")
@@ -685,6 +697,10 @@ def main(argv=None) -> int:
     elif args.cmd == "debug":
         debug_dump(args.home, args.rpc, args.output)
         print(json.dumps({"dump": args.output}))
+    elif args.cmd == "abci":
+        from tendermint_tpu.cli.abci_console import main as abci_main
+
+        abci_main(args.app, args.batch_file)
     elif args.cmd == "version":
         print(VERSION)
     elif args.cmd == "light":

@@ -313,7 +313,17 @@ def _pow2k(a: jnp.ndarray, k: int) -> jnp.ndarray:
     """a^(2^k): short runs inline; long runs as a fori_loop whose body does
     _POW2K_CHUNK squarings. The chunking balances compile time (the inversion
     ladders contain ~500 squarings; fully inline they dominate the kernel's
-    HLO count) against loop-iteration overhead."""
+    HLO count) against loop-iteration overhead. On TPU, long runs fuse into
+    Pallas square-chain kernels instead — the fori_loop form spent ~14 ms
+    per verification call in device while-loop overhead (traced r4)."""
+    if k >= _POW2K_CHUNK:
+        try:
+            from tendermint_tpu.ops import pallas_fe
+
+            if pallas_fe.enabled():
+                return pallas_fe.fsquare_chain(a, k)
+        except Exception:  # pragma: no cover - pallas unavailable
+            pass
     q, r = divmod(k, _POW2K_CHUNK)
     if q >= 2:
         def body(_, x):

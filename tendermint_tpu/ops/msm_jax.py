@@ -252,20 +252,44 @@ def fenwick_node_indices(ends: np.ndarray, n_lanes: int) -> np.ndarray:
 
 def sort_windows(digits: np.ndarray):
     """digits: (n_lanes, T) uint8 — window w digit of lane i is byte w of
-    its scalar. Returns (perm (T, N) int32, node_idx (T, NBUCKETS, K) int32).
-    """
+    its scalar. Returns (perm (T, N), ends (T, NBUCKETS) int32).
+
+    Upload-lean by design (the device tunnel moves ~20-40 MB/s, measured, so
+    warm-call argument bytes ARE latency): perm ships as uint16 whenever the
+    lane count fits (every production bucket), and instead of the
+    (T, 256, 17) Fenwick node table only the (T, 256) bucket-boundary `ends`
+    go to the device — ~32 KB vs ~0.5 MB — with the node decomposition
+    recomputed on-device (fenwick_nodes_device, pure elementwise int ops)."""
     n, t = digits.shape
     # per-column stable argsort in ONE call (axis=0), then counts via a
     # single bincount over offset digits
+    idt = np.uint16 if n < (1 << 16) else np.int32
     perm = np.ascontiguousarray(
-        np.argsort(digits, axis=0, kind="stable").T.astype(np.int32)
+        np.argsort(digits, axis=0, kind="stable").T.astype(idt)
     )  # (T, n)
     offs = (np.arange(t, dtype=np.int64) * NBUCKETS)[None, :]
     flat = digits.astype(np.int64) + offs  # (n, T)
     counts = np.bincount(flat.ravel(), minlength=t * NBUCKETS).reshape(t, NBUCKETS)
-    ends = np.cumsum(counts, axis=1)
-    node_idx = fenwick_node_indices(ends, n)
-    return perm, node_idx
+    ends = np.cumsum(counts, axis=1).astype(np.int32)
+    return perm, ends
+
+
+def fenwick_nodes_device(ends: jnp.ndarray, n_lanes: int) -> jnp.ndarray:
+    """Device-side fenwick_node_indices: ends (T, NBUCKETS) int32 ->
+    (T, NBUCKETS, FENWICK_K) int32. Same derivation, elementwise."""
+    offs, total = level_offsets(n_lanes)
+    lvls = min(FENWICK_K, len(offs))
+    e = jnp.asarray(ends).astype(jnp.int32)[..., None]  # (T, 256, 1)
+    lvl = jnp.arange(lvls, dtype=jnp.int32)
+    bit = (e >> lvl) & 1
+    idx = jnp.asarray(np.asarray(offs[:lvls], dtype=np.int32)) + (
+        (e >> (lvl + 1)) << 1
+    )
+    out = jnp.where(bit == 1, idx, jnp.int32(total))
+    if lvls < FENWICK_K:
+        pad = jnp.full((*out.shape[:-1], FENWICK_K - lvls), total, jnp.int32)
+        out = jnp.concatenate([out, pad], axis=-1)
+    return out
 
 
 
@@ -370,12 +394,14 @@ def _tree_levels(C: SmallCtx, p: Point) -> Point:
 
 def _gather_lanes(p: Point, perm: jnp.ndarray) -> Point:
     """p coords (20, N); perm (T, N) -> coords (20, T, N)."""
+    perm = jnp.asarray(perm).astype(jnp.int32)  # uint16 on the wire
     return Point(*(c[:, perm] for c in p))
 
 
 def _gather_nodes(tree: Point, node_idx: jnp.ndarray) -> Point:
     """tree coords (20, T, Wtot+1); node_idx (T, NBUCKETS, K) ->
     (20, T, NBUCKETS, K)."""
+    node_idx = jnp.asarray(node_idx).astype(jnp.int32)  # uint16 on the wire
     t_, flat = node_idx.shape[0], node_idx.shape[1] * node_idx.shape[2]
     idx = node_idx.reshape(1, t_, flat)
     out = []
@@ -538,13 +564,14 @@ def _msm_is_identity(C: SmallCtx, pts: Point, perm, node_idx) -> jnp.ndarray:
 
 def _rlc_core(
     pts_bytes: jnp.ndarray,  # (32, N) uint8 — A lanes, B lane, R lanes, pads
-    perm: jnp.ndarray,  # (T, N) int32
-    node_idx: jnp.ndarray,  # (T, NBUCKETS, K) int32
+    perm: jnp.ndarray,  # (T, N) int/uint
+    ends: jnp.ndarray,  # (T, NBUCKETS) int32 bucket boundaries
     fctx: FieldCtx,  # materialized at batch shape (N,) for decompress
     C: SmallCtx,
 ) -> jnp.ndarray:
     """Returns bool (1+N,): [batch_ok, lane_ok...] packed into ONE array so
     the caller syncs in a single D2H round trip."""
+    node_idx = fenwick_nodes_device(ends, pts_bytes.shape[1])
     p, ok = decompress(fctx, pts_bytes)
     p = _pselect(ok, p, identity(fctx))
     bok = _msm_is_identity(C, p, perm, node_idx)
@@ -555,12 +582,13 @@ def _rlc_core_cached(
     ax, ay, az, at,  # (20, Na) predecompressed A block (incl. B lane)
     r_bytes,  # (32, Nr) uint8
     perm,
-    node_idx,
+    ends,  # (T, NBUCKETS) int32
     fctx: FieldCtx,  # at shape (Nr,)
     C: SmallCtx,
 ) -> jnp.ndarray:
     """Cached-A variant: lanes = [A block | R block]; only R is decompressed.
     Returns bool (1+Nr,): [batch_ok, r_ok...]."""
+    node_idx = fenwick_nodes_device(ends, ax.shape[1] + r_bytes.shape[1])
     r, r_ok = decompress(fctx, r_bytes)
     r = _pselect(r_ok, r, identity(fctx))
     pts = Point(
@@ -578,7 +606,7 @@ def _rlc_core_cached_mixed(
     ed_r_bytes,  # (32, Ne) uint8 — ed25519 R encodings
     sr_r_bytes,  # (32, Ns) uint8 — ristretto255 R encodings
     perm,
-    node_idx,
+    ends,  # (T, NBUCKETS) int32
     fctx_ed: FieldCtx,  # at shape (Ne,)
     fctx_sr: FieldCtx,  # at shape (Ns,)
     C: SmallCtx,
@@ -586,6 +614,10 @@ def _rlc_core_cached_mixed(
     """Mixed-key-type cached-A variant: lanes = [A block | edR | srR].
     Returns bool (1+Ne+Ns,): [batch_ok, ed_r_ok..., sr_r_ok...]."""
     from tendermint_tpu.ops.ristretto_jax import ristretto_decode
+
+    node_idx = fenwick_nodes_device(
+        ends, ax.shape[1] + ed_r_bytes.shape[1] + sr_r_bytes.shape[1]
+    )
 
     er, er_ok = decompress(fctx_ed, ed_r_bytes)
     er = _pselect(er_ok, er, identity(fctx_ed))
@@ -637,10 +669,10 @@ def rlc_check_submit(pts_bytes: np.ndarray, scalars: Sequence[int]):
     [batch_ok, lane_ok...] — np.asarray() it to sync."""
     n = pts_bytes.shape[0]
     digits = scalars_to_bytes(scalars, n)
-    perm, node_idx = sort_windows(digits)
+    perm, ends = sort_windows(digits)
     fctx = make_ctx((n,))
     return _rlc_jit(
-        np.ascontiguousarray(pts_bytes.T), perm, node_idx, fctx, make_small_ctx()
+        np.ascontiguousarray(pts_bytes.T), perm, ends, fctx, make_small_ctx()
     )
 
 
@@ -660,13 +692,13 @@ def rlc_check_cached_submit(
     nr = r_bytes.shape[0]
     n = na + nr
     digits = scalars_to_bytes(scalars, n)
-    perm, node_idx = sort_windows(digits)
+    perm, ends = sort_windows(digits)
     fctx = make_ctx((nr,))
     return _rlc_cached_jit(
         *a_coords,
         np.ascontiguousarray(r_bytes.T),
         perm,
-        node_idx,
+        ends,
         fctx,
         make_small_ctx(),
     )
@@ -694,13 +726,13 @@ def rlc_check_cached_mixed_submit(
     ns = sr_r_bytes.shape[0]
     n = na + ne + ns
     digits = scalars_to_bytes(scalars, n)
-    perm, node_idx = sort_windows(digits)
+    perm, ends = sort_windows(digits)
     return _rlc_cached_mixed_jit(
         *a_coords,
         np.ascontiguousarray(ed_r_bytes.T),
         np.ascontiguousarray(sr_r_bytes.T),
         perm,
-        node_idx,
+        ends,
         make_ctx((ne,)),
         make_ctx((ns,)),
         make_small_ctx(),

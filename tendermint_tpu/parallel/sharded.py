@@ -181,8 +181,8 @@ def sharded_rlc_check(mesh: Mesh):
     is replicated. Per-lane decompress-validity flags stay sharded. One
     all_gather of ~320 bytes is the ONLY cross-chip traffic.
 
-    Returns run(pts_bytes[D,32,n], perm[D,T,n], node_idx[D,T,256,K]) ->
-    (batch_ok bool replicated, lane_ok [D, n] sharded).
+    Returns run(pts_bytes[D,32,n], perm[D,T,n], ends[D,T,256]) ->
+    (batch_ok bool replicated, lane_ok [D*n] flattened).
     """
     from tendermint_tpu.ops.ed25519_jax import decompress, identity
     from tendermint_tpu.ops.msm_jax import (
@@ -213,10 +213,12 @@ def sharded_rlc_check(mesh: Mesh):
                 out_specs=(P(), P(axis)),
                 check_vma=False,
             )
-            def _run(pts_bytes, perm, node_idx, fctx, C):
+            def _run(pts_bytes, perm, ends, fctx, C):
+                from tendermint_tpu.ops.msm_jax import fenwick_nodes_device
+
                 pts_bytes = pts_bytes[0]  # (32, n) local shard
                 perm = perm[0]
-                node_idx = node_idx[0]
+                node_idx = fenwick_nodes_device(ends[0], n)
                 p, ok = decompress(fctx, pts_bytes)
                 p = _pselect(ok, p, identity(fctx))
                 part = _msm_total(C, p, perm, node_idx)  # partial sum (20,)
@@ -237,10 +239,10 @@ def sharded_rlc_check(mesh: Mesh):
             )
         return fn
 
-    def run(pts_bytes, perm, node_idx):
+    def run(pts_bytes, perm, ends):
         if pts_bytes.shape[0] != ndev:
             raise ValueError(f"leading axis {pts_bytes.shape[0]} != mesh size {ndev}")
-        bok, ok = _for_lanes(pts_bytes.shape[2])(pts_bytes, perm, node_idx)
+        bok, ok = _for_lanes(pts_bytes.shape[2])(pts_bytes, perm, ends)
         return bok, ok.reshape(-1)
 
     return run
@@ -248,8 +250,9 @@ def sharded_rlc_check(mesh: Mesh):
 
 def prepare_rlc_shards(pts_bytes, scalars, ndev: int):
     """Host prep for sharded_rlc_check: split lanes into ndev contiguous
-    chunks, per-chunk window sort + fenwick indices (ops/msm_jax.py
-    sort_windows). pts_bytes (N, 32) uint8, N divisible by ndev."""
+    chunks, per-chunk window sort + bucket boundaries (ops/msm_jax.py
+    sort_windows; fenwick indices derive on-device). pts_bytes (N, 32)
+    uint8, N divisible by ndev."""
     import numpy as np
 
     from tendermint_tpu.ops.msm_jax import scalars_to_bytes, sort_windows
@@ -262,10 +265,10 @@ def prepare_rlc_shards(pts_bytes, scalars, ndev: int):
     pts, perms, nodes = [], [], []
     for d in range(ndev):
         sl = slice(d * per, (d + 1) * per)
-        perm, node_idx = sort_windows(digits[sl])
+        perm, ends = sort_windows(digits[sl])
         pts.append(np.ascontiguousarray(pts_bytes[sl].T))
         perms.append(perm)
-        nodes.append(node_idx)
+        nodes.append(ends)
     return np.stack(pts), np.stack(perms), np.stack(nodes)
 
 

@@ -1,0 +1,64 @@
+"""abci-cli conformance: golden-output round trips for the ABCI console
+(cli/abci_console.py; reference: abci/tests/test_cli/ ex1/ex2 golden files +
+abci/cmd/abci-cli). The same scripts also run against an OUT-OF-PROCESS
+socket server to prove the console drives remote apps identically."""
+
+import io
+import os
+
+import pytest
+
+HERE = os.path.dirname(__file__)
+
+
+def run_script(app_spec: str, script_name: str) -> str:
+    from tendermint_tpu.cli.abci_console import AbciConsole
+
+    out = io.StringIO()
+    console = AbciConsole(app_spec)
+    try:
+        with open(os.path.join(HERE, "testdata", script_name)) as f:
+            console.run_batch(f.read(), out)
+    finally:
+        console.close()
+    return out.getvalue()
+
+
+def golden(name: str) -> str:
+    with open(os.path.join(HERE, "testdata", name)) as f:
+        return f.read()
+
+
+def test_kvstore_golden_roundtrip():
+    assert run_script("kvstore", "abci_ex1.abci") == golden("abci_ex1.abci.out")
+
+
+def test_counter_golden_roundtrip():
+    assert run_script("counter", "abci_ex2.abci") == golden("abci_ex2.abci.out")
+
+
+def test_unknown_command_and_app():
+    from tendermint_tpu.cli.abci_console import AbciConsole
+
+    out = io.StringIO()
+    console = AbciConsole("kvstore")
+    console.run_line("frobnicate 0x00", out)
+    assert "-> error:" in out.getvalue()
+    with pytest.raises(ValueError):
+        AbciConsole("not-an-app")
+
+
+def test_console_against_socket_server():
+    """The conformance scripts must produce IDENTICAL output when the app
+    runs out-of-process behind the ABCI socket protocol."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.abci.socket import SocketServer
+
+    server = SocketServer("tcp://127.0.0.1:0", KVStoreApplication())
+    server.start()
+    try:
+        port = server.bound_addr[1]
+        got = run_script(f"tcp://127.0.0.1:{port}", "abci_ex1.abci")
+        assert got == golden("abci_ex1.abci.out")
+    finally:
+        server.stop()
