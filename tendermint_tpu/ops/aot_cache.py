@@ -1,0 +1,145 @@
+"""AOT artifact cache: cold-start without retracing.
+
+The RLC kernels trace to ~400k jaxpr equations (every Pallas call site
+inlines its kernel body), so a FRESH PROCESS pays ~70 s of pure Python
+tracing/lowering per (kernel, shape bucket) — even when XLA's persistent
+compile cache HITS (measured r4: 71 s first call on a cache hit, 27 s of
+which was XLA; the rest tracing). jax.export solves this: the traced+
+lowered StableHLO is serialized to disk once, and later processes
+deserialize and call it directly — no tracing.
+
+Artifacts live in .jax_cache/export/, keyed by kernel name + arg
+shapes/dtypes + a hash of the kernel source files (so any kernel edit
+invalidates them). XLA compilation of a deserialized artifact still goes
+through the persistent compile cache, so a warm machine pays only
+deserialize + device program load."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+import threading
+from typing import Callable, Dict
+
+import jax
+import numpy as np
+
+_LOCK = threading.Lock()
+_MEM: Dict[str, Callable] = {}
+_SRC_HASH: str | None = None
+
+
+def _src_hash() -> str:
+    """Hash of the kernel-defining sources: edits invalidate artifacts."""
+    global _SRC_HASH
+    if _SRC_HASH is None:
+        h = hashlib.sha256()
+        base = os.path.dirname(os.path.abspath(__file__))
+        for mod in (
+            "fe25519.py",
+            "ed25519_jax.py",
+            "msm_jax.py",
+            "pallas_fe.py",
+            "ristretto_jax.py",  # traced into the mixed kernel
+        ):
+            with open(os.path.join(base, mod), "rb") as f:
+                h.update(f.read())
+        h.update(jax.__version__.encode())
+        _SRC_HASH = h.hexdigest()[:16]
+    return _SRC_HASH
+
+
+def _cache_dir() -> str | None:
+    d = jax.config.jax_compilation_cache_dir or os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR"
+    )
+    if not d:
+        return None
+    return os.path.join(d, "export")
+
+
+def _arg_key(args) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(args):
+        h.update(str(np.shape(leaf)).encode())
+        h.update(str(np.asarray(leaf).dtype if not hasattr(leaf, "dtype") else leaf.dtype).encode())
+    return h.hexdigest()[:16]
+
+
+_REGISTERED = False
+
+
+def _register_pytrees() -> None:
+    """The kernel arg NamedTuples must be registered for export
+    serialization (once per process)."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    from jax import export as jexport
+
+    from tendermint_tpu.ops.ed25519_jax import FieldCtx
+    from tendermint_tpu.ops.msm_jax import SmallCtx
+
+    for t in (FieldCtx, SmallCtx):
+        try:
+            jexport.register_namedtuple_serialization(
+                t, serialized_name=f"tendermint_tpu.{t.__name__}"
+            )
+        except ValueError:
+            pass  # already registered
+    _REGISTERED = True
+
+
+def enabled() -> bool:
+    return os.environ.get("TMTPU_AOT", "1") != "0" and jax.default_backend() != "cpu"
+
+
+def call(name: str, jit_fn, *args):
+    """Call `jit_fn(*args)` through the AOT artifact cache.
+
+    First use on a machine: traces + exports + serializes (background cost,
+    same as before). Later processes: deserialize (~1 s) instead of
+    retracing (~70 s). Falls back to the plain jit call on any export
+    machinery failure."""
+    if not enabled():
+        return jit_fn(*args)
+    key = f"{name}-{_src_hash()}-{_arg_key(args)}"
+    fn = _MEM.get(key)
+    if fn is not None:
+        return fn(*args)
+    try:
+        from jax import export as jexport
+
+        _register_pytrees()
+        d = _cache_dir()
+        path = os.path.join(d, key + ".bin") if d else None
+        exp = None
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                exp = jexport.deserialize(bytearray(f.read()))
+        if exp is None:
+            exp = jexport.export(jit_fn)(*args)
+            if path:
+                os.makedirs(d, exist_ok=True)
+                blob = exp.serialize()
+                fd, tmp = tempfile.mkstemp(dir=d, prefix=".aot-")
+                with os.fdopen(fd, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, path)
+        wrapped = jax.jit(exp.call)
+    except Exception:
+        import logging
+
+        logging.getLogger("tendermint_tpu.ops.aot").exception(
+            "AOT export cache failed for %s; using plain jit", name
+        )
+        with _LOCK:
+            _MEM[key] = jit_fn
+        return jit_fn(*args)
+    with _LOCK:
+        _MEM[key] = wrapped
+    # Outside the try: a RUNTIME error here (device OOM, transient tunnel
+    # failure) must propagate as itself, not be mislabeled as an export
+    # failure and permanently disable the AOT path for this key.
+    return wrapped(*args)

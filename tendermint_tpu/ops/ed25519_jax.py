@@ -362,4 +362,8 @@ def verify_prepared(
         for x in (a_bytes, r_bytes, s_digits, h_digits)
     ):
         return _verify_core(a_bytes, r_bytes, s_digits, h_digits, _trace_ctx(batch))
-    return _verify_jit(a_bytes, r_bytes, s_digits, h_digits, make_ctx(batch))
+    from tendermint_tpu.ops import aot_cache  # lazy: avoids import cycle
+
+    return aot_cache.call(
+        "persig", _verify_jit, a_bytes, r_bytes, s_digits, h_digits, make_ctx(batch)
+    )

@@ -71,6 +71,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tendermint_tpu.ops import aot_cache
 from tendermint_tpu.ops import fe25519 as fe
 from tendermint_tpu.ops.ed25519_jax import (
     FieldCtx,
@@ -671,8 +672,9 @@ def rlc_check_submit(pts_bytes: np.ndarray, scalars: Sequence[int]):
     digits = scalars_to_bytes(scalars, n)
     perm, ends = sort_windows(digits)
     fctx = make_ctx((n,))
-    return _rlc_jit(
-        np.ascontiguousarray(pts_bytes.T), perm, ends, fctx, make_small_ctx()
+    return aot_cache.call(
+        "rlc_plain", _rlc_jit,
+        np.ascontiguousarray(pts_bytes.T), perm, ends, fctx, make_small_ctx(),
     )
 
 
@@ -694,7 +696,8 @@ def rlc_check_cached_submit(
     digits = scalars_to_bytes(scalars, n)
     perm, ends = sort_windows(digits)
     fctx = make_ctx((nr,))
-    return _rlc_cached_jit(
+    return aot_cache.call(
+        "rlc_cached", _rlc_cached_jit,
         *a_coords,
         np.ascontiguousarray(r_bytes.T),
         perm,
@@ -727,7 +730,8 @@ def rlc_check_cached_mixed_submit(
     n = na + ne + ns
     digits = scalars_to_bytes(scalars, n)
     perm, ends = sort_windows(digits)
-    return _rlc_cached_mixed_jit(
+    return aot_cache.call(
+        "rlc_mixed", _rlc_cached_mixed_jit,
         *a_coords,
         np.ascontiguousarray(ed_r_bytes.T),
         np.ascontiguousarray(sr_r_bytes.T),

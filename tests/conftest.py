@@ -16,8 +16,13 @@ _platform = os.environ.get("TMTPU_TEST_PLATFORM", "cpu")
 
 # Persistent compilation cache: the ed25519 scan kernel is expensive to compile
 # on CPU; cache it across pytest runs.
+# CPU-backend cache lives in its own subdirectory: sharing one dir with the
+# TPU bench/tools processes produced entries that CRASHED (SIGSEGV/SIGABRT)
+# the cache READ path in concurrent sessions (observed r4, twice, both in
+# compilation_cache.get_executable_and_time).
 os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache", _platform),
 )
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -35,6 +40,8 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", _platform)
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".jax_cache")),
+    os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache", _platform)
+    ),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
