@@ -413,7 +413,12 @@ def main():
     # The env vars at the top are ignored when an injected sitecustomize has
     # already imported jax at interpreter start; config.update works
     # post-import.
-    jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
+    cache_dir = os.environ["JAX_COMPILATION_CACHE_DIR"]
+    if jax.default_backend() == "cpu":
+        # never mix CPU entries into the TPU cache dir (corrupted entries
+        # crashed the cache read path; see tests/conftest.py)
+        cache_dir = os.path.join(cache_dir, "cpu")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     log("devices:", jax.devices())

@@ -27,6 +27,7 @@ import numpy as np
 
 _LOCK = threading.Lock()
 _MEM: Dict[str, Callable] = {}
+_KEY_LOCKS: Dict[str, object] = {}
 _SRC_HASH: str | None = None
 
 
@@ -104,10 +105,22 @@ def call(name: str, jit_fn, *args):
     machinery failure."""
     if not enabled():
         return jit_fn(*args)
-    key = f"{name}-{_src_hash()}-{_arg_key(args)}"
+    key = f"{name}-{jax.default_backend()}-{_src_hash()}-{_arg_key(args)}"
     fn = _MEM.get(key)
     if fn is not None:
         return fn(*args)
+    # per-key in-flight guard: the prewarm thread and the event loop must
+    # not both pay the ~70s export trace for the same kernel
+    with _LOCK:
+        klock = _KEY_LOCKS.setdefault(key, __import__("threading").Lock())
+    with klock:
+        fn = _MEM.get(key)
+        if fn is not None:
+            return fn(*args)
+        return _call_locked(name, key, jit_fn, *args)
+
+
+def _call_locked(name, key, jit_fn, *args):
     try:
         from jax import export as jexport
 
