@@ -22,6 +22,22 @@ from tendermint_tpu.ops.ed25519_jax import verify_prepared
 from tendermint_tpu.parallel.sharded import make_mesh, shard_batch_arrays, sharded_verify
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _free_compile_memory():
+    """XLA:CPU compilation of the 8-virtual-device sharded kernels peaks at
+    tens of GB of compiler memory; after ~200 suite tests' accumulated
+    executables it ABORTED inside backend_compile (observed r4). Dropping
+    every previously-compiled executable first keeps the full-suite process
+    under the ceiling (later modules reload from the persistent cache)."""
+    import gc
+
+    import jax as _jax
+
+    _jax.clear_caches()
+    gc.collect()
+    yield
+
+
 def make_inputs(n):
     pubs, msgs, sigs = [], [], []
     for i in range(n):
