@@ -82,6 +82,15 @@ def _rlc_enabled() -> bool:
 
 
 def backend_default() -> str:
+    from tendermint_tpu.crypto.keys import cofactorless_mode
+
+    if cofactorless_mode():
+        # Reference-exact (cofactorless) interop mode: the device kernels
+        # are cofactored by construction, so default-routed verification
+        # stays on the host (crypto/keys.Ed25519PubKey.verify, which skips
+        # the cofactored referee in this mode). Explicit backend="jax"
+        # requests are still honored (and stay cofactored).
+        return "cpu"
     env = os.environ.get("TMTPU_CRYPTO_BACKEND")
     if env:
         return env
@@ -138,6 +147,25 @@ def prepare_batch(
     r = np.zeros((b, 32), dtype=np.uint8)
     s = np.zeros((b, 32), dtype=np.uint8)
     h = np.zeros((b, 32), dtype=np.uint8)
+    from tendermint_tpu import native
+
+    if n and native.available():
+        precheck, a_rows, r_rows, s_rows, h_rows = _precheck_and_hash_fast(
+            pubkeys, msgs, sigs
+        )
+        if precheck.any():
+            a[:n][precheck] = a_rows[precheck]
+            r[:n][precheck] = r_rows[precheck]
+            s[:n][precheck] = s_rows[precheck]
+            h[:n][precheck] = h_rows[precheck]
+        return (
+            np.ascontiguousarray(a.T),
+            np.ascontiguousarray(r.T),
+            _signed_radix16(s),
+            _signed_radix16(h),
+            precheck,
+            n,
+        )
     precheck = np.zeros(n, dtype=bool)
     for i in range(n):
         pk, msg, sig = bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i])
@@ -162,6 +190,82 @@ def prepare_batch(
         precheck,
         n,
     )
+
+
+_L_BE = np.frombuffer(L.to_bytes(32, "big"), dtype=np.uint8)
+
+
+def _s_canonical_rows(s_rows: np.ndarray) -> np.ndarray:
+    """Vectorized canonical-s check: s < L per (n, 32) little-endian row
+    (lexicographic compare on the byte-reversed rows)."""
+    n = s_rows.shape[0]
+    s_be = s_rows[:, ::-1]
+    neq = s_be != _L_BE
+    first = neq.argmax(axis=1)
+    rows = np.arange(n)
+    return neq.any(axis=1) & (s_be[rows, first] < _L_BE[first])
+
+
+def _precheck_and_hash_fast(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+):
+    """Native-backed `_precheck_and_hash` for pure-ed25519 batches: the
+    challenge hashes h_i = SHA512(R||A||M) mod L run as multithreaded C
+    (tendermint_tpu/native) instead of a serial hashlib loop, and scalars
+    stay in the bytes domain (no Python bigints on the hot path).
+
+    Returns (precheck bool[n], a_rows (n,32) u8, r_rows (n,32) u8,
+    s_rows (n,32) u8, h_rows (n,32) u8). Rows failing precheck have
+    h zeroed; a/r/s rows are only meaningful where precheck holds."""
+    from tendermint_tpu import native
+
+    n = len(pubkeys)
+    pubkeys = [bytes(p) for p in pubkeys]
+    sigs = [bytes(s) for s in sigs]
+    len_ok = np.fromiter(
+        (len(p) == 32 and len(s) == 64 for p, s in zip(pubkeys, sigs)),
+        dtype=bool,
+        count=n,
+    )
+    if not len_ok.all():
+        zpk, zsig = bytes(32), bytes(64)
+        pubkeys = [p if k else zpk for p, k in zip(pubkeys, len_ok)]
+        sigs = [s if k else zsig for s, k in zip(sigs, len_ok)]
+        msgs = [m if k else b"" for m, k in zip(msgs, len_ok)]
+    pks_blob = b"".join(pubkeys)
+    sigs_blob = b"".join(sigs)
+    msgs = [bytes(m) for m in msgs]
+    moffs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.fromiter(map(len, msgs), dtype=np.int64, count=n), out=moffs[1:])
+    sig_arr = np.frombuffer(sigs_blob, dtype=np.uint8).reshape(n, 64)
+    a_rows = np.frombuffer(pks_blob, dtype=np.uint8).reshape(n, 32)
+    r_rows = sig_arr[:, :32]
+    s_rows = sig_arr[:, 32:]
+    precheck = len_ok & _s_canonical_rows(s_rows)
+    h_rows = native.ed25519_h_batch(sigs_blob, pks_blob, b"".join(msgs), moffs)
+    h_rows[~precheck] = 0
+    return precheck, a_rows, r_rows, s_rows, h_rows
+
+
+def _rlc_scalars_fast(precheck: np.ndarray, s_rows: np.ndarray, h_rows: np.ndarray):
+    """Bytes-domain `_rlc_scalars`: same z-sampling semantics (~124-bit,
+    nonzero, forced ≡ 0 mod 8; see _sample_z) with the z*h mod 8L and
+    Σ z*s mod L math in native C. Returns (z16 (n,16) u8, w (n,32) u8,
+    u int)."""
+    from tendermint_tpu import native
+
+    n = s_rows.shape[0]
+    rng = np.random.default_rng()  # OS-entropy seeded per call
+    zw = rng.integers(0, 1 << 64, size=(n, 2), dtype=np.uint64)
+    a = zw[:, 0] & np.uint64((1 << 57) - 1)
+    b = zw[:, 1] | np.uint64(1)
+    z = np.empty((n, 2), dtype="<u8")
+    z[:, 0] = b << np.uint64(3)
+    z[:, 1] = (a << np.uint64(3)) | (b >> np.uint64(61))
+    z16 = z.view(np.uint8).reshape(n, 16)
+    z16[~precheck] = 0
+    w_rows, u = native.rlc_scalars(z16, h_rows, s_rows)
+    return z16, w_rows, u
 
 
 def _precheck_and_hash(
@@ -398,9 +502,18 @@ def _rlc_submit(
     t0 = _time.perf_counter()
     n = len(pubkeys)
     mixed = key_types is not None and any(t == "sr25519" for t in key_types)
-    precheck, a_rows, r_rows, s_ints, hk_ints = _precheck_and_hash(
-        pubkeys, msgs, sigs, key_types if mixed else None
-    )
+    from tendermint_tpu import native
+
+    use_native = not mixed and native.available()
+    if use_native:
+        precheck, a_rows, r_rows, s_rows, h_rows = _precheck_and_hash_fast(
+            pubkeys, msgs, sigs
+        )
+        s_ints = hk_ints = None
+    else:
+        precheck, a_rows, r_rows, s_ints, hk_ints = _precheck_and_hash(
+            pubkeys, msgs, sigs, key_types if mixed else None
+        )
 
     types = key_types if mixed else ["ed25519"] * n
     ckeys = [_cache_key(bytes(pubkeys[i]), types[i]) for i in range(n)]
@@ -434,7 +547,11 @@ def _rlc_submit(
 
     # A-lane scalars mod 8L (exact for points of any order; kills torsion
     # since z ≡ 0 mod 8 survives the reduction), B-lane scalar mod L.
-    zs, w_scalars, u = _rlc_scalars(precheck, s_ints, hk_ints, n)
+    if use_native:
+        z16, w_rows, u = _rlc_scalars_fast(precheck, s_rows, h_rows)
+        zs = w_scalars = None
+    else:
+        zs, w_scalars, u = _rlc_scalars(precheck, s_ints, hk_ints, n)
 
     b_enc = np.frombuffer(point_compress(BASE), dtype=np.uint8)
     na = _lane_bucket(n + 1)
@@ -446,19 +563,25 @@ def _rlc_submit(
         import jax as _jax
 
         rows = np.flatnonzero(precheck)
-        cols = (
-            np.fromiter(
-                (_A_CACHE[ckeys[i]] for i in rows), dtype=np.int64, count=len(rows)
-            )
-            if len(rows)
-            else np.empty(0, dtype=np.int64)
-        )
-        key = (_A_GENERATION, na, rows.tobytes(), cols.tobytes())
+        # Snapshot the cache columns AND the store slice under one lock
+        # hold: a concurrent store-exhaustion reset (_fill_a_cache_locked)
+        # clears _A_CACHE and rewrites columns, so an unlocked read could
+        # see torn coordinates (advisor r4). The slice copy is small
+        # (4*20*|rows|*4 bytes) and write-once columns make reads cheap.
         with _A_LOCK:  # prewarm thread vs event loop (same model as fills)
+            cols = (
+                np.fromiter(
+                    (_A_CACHE[ckeys[i]] for i in rows), dtype=np.int64, count=len(rows)
+                )
+                if len(rows)
+                else np.empty(0, dtype=np.int64)
+            )
+            key = (_A_GENERATION, na, rows.tobytes(), cols.tobytes())
             hit = _DEV_A_CACHE.pop(key, None)
             if hit is not None:
                 _DEV_A_CACHE[key] = hit  # LRU refresh
                 return hit
+            store_slice = _A_STORE[:, :, cols].copy() if len(rows) else None
         bx, by, bz, bt = msm_jax.basepoint_coords()
         block = np.empty((4, 20, na), dtype=np.int32)
         block[0] = bx[:, None]
@@ -466,7 +589,7 @@ def _rlc_submit(
         block[2] = bz[:, None]
         block[3] = bt[:, None]
         if len(rows):
-            block[:, :, rows] = _A_STORE[:, :, cols]
+            block[:, :, rows] = store_slice
         dev = tuple(_jax.device_put(block[c]) for c in range(4))
         with _A_LOCK:
             while len(_DEV_A_CACHE) >= _DEV_A_MAX:
@@ -508,10 +631,20 @@ def _rlc_submit(
     if precheck.any():
         pts_r[:n][precheck] = r_rows[precheck]
 
-    scalars = [0] * (2 * na)
-    scalars[:n] = w_scalars
-    scalars[n] = (L - u) % L
-    scalars[na : na + n] = [zs[i] if precheck[i] else 0 for i in range(n)]
+    if use_native:
+        # Scalars stay in the bytes domain end to end: the (2*na, 32) digit
+        # rows feed the window sort directly (no bigint list round trip).
+        scalars = np.zeros((2 * na, 32), dtype=np.uint8)
+        scalars[:n] = w_rows
+        scalars[n] = np.frombuffer(
+            ((L - u) % L).to_bytes(32, "little"), dtype=np.uint8
+        )
+        scalars[na : na + n, :16] = z16  # already zeroed where ~precheck
+    else:
+        scalars = [0] * (2 * na)
+        scalars[:n] = w_scalars
+        scalars[n] = (L - u) % L
+        scalars[na : na + n] = [zs[i] if precheck[i] else 0 for i in range(n)]
 
     if cached:
         dev = msm_jax.rlc_check_cached_submit(_a_block(), pts_r, scalars)
@@ -676,8 +809,19 @@ def _verify_batch_rlc_sharded(
         return None
     nd, _, rlc_run = env
     n = len(pubkeys)
-    precheck, a_rows, r_rows, s_ints, hk_ints = _precheck_and_hash(pubkeys, msgs, sigs)
-    zs, w_scalars, u = _rlc_scalars(precheck, s_ints, hk_ints, n)
+    from tendermint_tpu import native
+
+    use_native = native.available()
+    if use_native:
+        precheck, a_rows, r_rows, s_rows, h_rows = _precheck_and_hash_fast(
+            pubkeys, msgs, sigs
+        )
+        z16, w_rows, u = _rlc_scalars_fast(precheck, s_rows, h_rows)
+    else:
+        precheck, a_rows, r_rows, s_ints, hk_ints = _precheck_and_hash(
+            pubkeys, msgs, sigs
+        )
+        zs, w_scalars, u = _rlc_scalars(precheck, s_ints, hk_ints, n)
 
     # NOTE: no decoded-pubkey cache on this path yet — every height
     # re-decodes A in-kernel (acceptable: this path only runs on multi-chip
@@ -691,10 +835,18 @@ def _verify_batch_rlc_sharded(
     if precheck.any():
         pts[:n][precheck] = a_rows[precheck]
         pts[na : na + n][precheck] = r_rows[precheck]
-    scalars = [0] * (2 * na)
-    scalars[:n] = w_scalars
-    scalars[n] = (L - u) % L
-    scalars[na : na + n] = [zs[i] if precheck[i] else 0 for i in range(n)]
+    if use_native:
+        scalars = np.zeros((2 * na, 32), dtype=np.uint8)
+        scalars[:n] = w_rows
+        scalars[n] = np.frombuffer(
+            ((L - u) % L).to_bytes(32, "little"), dtype=np.uint8
+        )
+        scalars[na : na + n, :16] = z16  # zeroed where ~precheck
+    else:
+        scalars = [0] * (2 * na)
+        scalars[:n] = w_scalars
+        scalars[n] = (L - u) % L
+        scalars[na : na + n] = [zs[i] if precheck[i] else 0 for i in range(n)]
 
     try:
         bok, ok = rlc_run(*prepare_rlc_shards(pts, scalars, nd))

@@ -1,0 +1,168 @@
+"""Native (C) host-prep kernels, built on first use.
+
+The RLC batch path's host side — challenge hashing, scalar math, window
+sort — was ~150 ms of Python/hashlib at 10k validators (PERF.md), more
+than the device kernel it feeds. batchhost.c implements the three hot
+loops as multithreaded C; this module compiles it once (gcc, cached by
+source hash) and binds via ctypes. Everything degrades gracefully: if no
+compiler is available or the build fails, `available()` is False and
+callers keep their pure-Python paths.
+
+Set TMTPU_NATIVE=0 to force the Python paths (differential testing).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_log = logging.getLogger("tendermint_tpu.native")
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+_BASE = os.path.dirname(os.path.abspath(__file__))
+_NTHREADS = min(8, os.cpu_count() or 1)
+
+
+def _build() -> "ctypes.CDLL | None":
+    src = os.path.join(_BASE, "batchhost.c")
+    with open(src, "rb") as f:
+        src_bytes = f.read()
+    tag = hashlib.sha256(src_bytes).hexdigest()[:16]
+    build_dir = os.path.join(_BASE, "_build")
+    so_path = os.path.join(build_dir, f"batchhost-{tag}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(build_dir, exist_ok=True)
+        hdr = os.path.join(build_dir, "sha512_constants.h")
+        if not os.path.exists(hdr):
+            from tendermint_tpu.native.gen_constants import generate
+
+            fd, tmp = tempfile.mkstemp(dir=build_dir, prefix=".hdr-")
+            with os.fdopen(fd, "w") as f:
+                f.write(generate())
+            os.replace(tmp, hdr)
+        fd, tmp = tempfile.mkstemp(dir=build_dir, prefix=".so-", suffix=".so")
+        os.close(fd)
+        cc = os.environ.get("CC", "gcc")
+        cmd = [
+            cc, "-O3", "-shared", "-fPIC", "-pthread",
+            "-I", build_dir, src, "-o", tmp,
+        ]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, text=True, timeout=120
+            )
+        except Exception as e:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            _log.warning("native batchhost build failed (%s); using Python paths", e)
+            return None
+        os.replace(tmp, so_path)
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError as e:
+        _log.warning("native batchhost load failed (%s); using Python paths", e)
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.tm_ed25519_h_batch.argtypes = [u8p, u8p, u8p, i64p, ctypes.c_int64, u8p, ctypes.c_int]
+    lib.tm_rlc_scalars.argtypes = [u8p, u8p, u8p, ctypes.c_int64, u8p, u8p, ctypes.c_int]
+    lib.tm_sort_windows.argtypes = [u8p, ctypes.c_int64, i32p, i32p, ctypes.c_int]
+    return lib
+
+
+def _lib() -> "ctypes.CDLL | None":
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    with _LOCK:
+        if not _TRIED:
+            if os.environ.get("TMTPU_NATIVE", "1") == "0":
+                _LIB = None
+            else:
+                try:
+                    _LIB = _build()
+                except Exception:
+                    _log.exception("native batchhost unavailable; using Python paths")
+                    _LIB = None
+            globals()["_TRIED"] = True
+    return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def ed25519_h_batch(
+    sigs_blob: bytes, pks_blob: bytes, msgs_blob: bytes, moffs: np.ndarray
+) -> np.ndarray:
+    """h_i = SHA-512(R_i || A_i || M_i) mod L for n rows.
+
+    sigs_blob: n*64 bytes (R = first 32 of each sig); pks_blob: n*32;
+    msgs_blob: concatenated messages with moffs (n+1,) int64 offsets.
+    Returns (n, 32) uint8 little-endian. Replaces the reference's per-row
+    hashing inside its serial verify loop (types/validator_set.go:690)."""
+    lib = _lib()
+    assert lib is not None
+    n = len(moffs) - 1
+    out = np.empty((n, 32), dtype=np.uint8)
+    sigs = np.frombuffer(sigs_blob, dtype=np.uint8)
+    pks = np.frombuffer(pks_blob, dtype=np.uint8)
+    msgs = np.frombuffer(msgs_blob, dtype=np.uint8) if msgs_blob else np.zeros(1, np.uint8)
+    moffs = np.ascontiguousarray(moffs, dtype=np.int64)
+    lib.tm_ed25519_h_batch(
+        _u8p(sigs), _u8p(pks), _u8p(msgs),
+        moffs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, _u8p(out), _NTHREADS,
+    )
+    return out
+
+
+def rlc_scalars(z16: np.ndarray, h32: np.ndarray, s32: np.ndarray):
+    """w_i = z_i*h_i mod 8L; u = sum z_i*s_i mod L. Rows with z == 0 are
+    excluded (w = 0, no contribution to u).
+
+    z16 (n,16), h32 (n,32), s32 (n,32) uint8 LE -> (w (n,32) uint8, u int)."""
+    lib = _lib()
+    assert lib is not None
+    n = z16.shape[0]
+    w = np.empty((n, 32), dtype=np.uint8)
+    u = np.empty(32, dtype=np.uint8)
+    z16 = np.ascontiguousarray(z16, dtype=np.uint8)
+    h32 = np.ascontiguousarray(h32, dtype=np.uint8)
+    s32 = np.ascontiguousarray(s32, dtype=np.uint8)
+    lib.tm_rlc_scalars(_u8p(z16), _u8p(h32), _u8p(s32), n, _u8p(w), _u8p(u), _NTHREADS)
+    return w, int.from_bytes(u.tobytes(), "little")
+
+
+def sort_windows(digits: np.ndarray):
+    """Per-window counting sort: digits (n, 32) uint8 row-major ->
+    (perm (32, n) int32 stable, ends (32, 256) int32). Same contract as
+    ops/msm_jax.sort_windows (which downcasts perm for the wire)."""
+    lib = _lib()
+    assert lib is not None
+    n = digits.shape[0]
+    digits = np.ascontiguousarray(digits, dtype=np.uint8)
+    perm = np.empty((32, n), dtype=np.int32)
+    ends = np.empty((32, 256), dtype=np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.tm_sort_windows(
+        _u8p(digits), n,
+        perm.ctypes.data_as(i32p), ends.ctypes.data_as(i32p), _NTHREADS,
+    )
+    return perm, ends

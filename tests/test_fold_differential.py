@@ -1,0 +1,53 @@
+"""CPU differential test for the Pallas-only pairwise window fold.
+
+`_fold_windows` (the production schedule behind `_combine_windows` on TPU)
+was previously exercised only via end-to-end verification on hardware — a
+regression in its pairing/shift arithmetic would not surface in the CPU
+suite (advisor r4). Here the SAME code path runs on CPU through the jnp
+point ops and is checked against both the lax.scan Horner form and a host
+bigint reference, over odd/even/one-window widths."""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.ops import fe25519 as fe
+from tendermint_tpu.ops.msm_jax import (
+    Point,
+    _combine_windows,
+    _fold_windows,
+    make_small_ctx,
+)
+
+
+def _w_pts(ks):
+    """Point coords (20, T) for W_w = [k_w] B."""
+    cols = []
+    for k in ks:
+        x, y, z, t = ref.point_mul(k, ref.BASE)
+        cols.append([fe.from_int(x), fe.from_int(y), fe.from_int(z), fe.from_int(t)])
+    return Point(
+        *(np.stack([c[i] for c in cols], axis=-1).astype(np.int32) for i in range(4))
+    )
+
+
+def _compress(p: Point) -> bytes:
+    x = fe.to_int(np.asarray(p.x)) % ref.P
+    y = fe.to_int(np.asarray(p.y)) % ref.P
+    z = fe.to_int(np.asarray(p.z)) % ref.P
+    t = fe.to_int(np.asarray(p.t)) % ref.P
+    return ref.point_compress((x, y, z, t))
+
+
+@pytest.mark.parametrize("t_windows", [1, 2, 3, 5, 8])
+def test_fold_matches_scan_and_reference(t_windows):
+    rng = np.random.default_rng(41 + t_windows)
+    ks = [int.from_bytes(rng.bytes(16), "little") | 1 for _ in range(t_windows)]
+    w = _w_pts(ks)
+    C = make_small_ctx()
+    folded = _fold_windows(C, w)
+    scanned = _combine_windows(C, w)  # CPU backend -> the scan/Horner form
+    assert _compress(folded) == _compress(scanned)
+    total = sum(k * (1 << (8 * i)) for i, k in enumerate(ks)) % ref.L
+    expected = ref.point_compress(ref.point_mul(total, ref.BASE))
+    assert _compress(folded) == expected
