@@ -165,15 +165,17 @@ class BlocksyncReactor(Reactor):
             if len(commit.signatures) != vals.size():
                 spans.append((start, 0, [], 1, False))
                 continue
+            idxs = []
             for idx, cs_sig in enumerate(commit.signatures):
                 if not cs_sig.for_block():
                     continue
                 val = vals.validators[idx]
                 pubkeys.append(val.pub_key.bytes())
-                msgs.append(commit.vote_sign_bytes(self.state.chain_id, idx))
+                idxs.append(idx)
                 sigs.append(cs_sig.signature)
                 key_types.append(val.pub_key.type_name())
                 powers.append(val.voting_power)
+            msgs.extend(commit.vote_sign_bytes_many(self.state.chain_id, idxs))
             ok_struct = commit.block_id == first_id and commit.height == first.header.height
             spans.append((start, len(sigs) - start, powers, vals.total_voting_power(), ok_struct))
         if not sigs:

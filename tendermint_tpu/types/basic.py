@@ -101,8 +101,17 @@ class BlockID:
     def key(self) -> bytes:
         # 8-byte width accommodates any varint-decodable total; callers are
         # expected to validate_basic() first, but key() itself must not raise
-        # on hostile input (it sits on the VoteSet.add_vote path).
-        return self.hash + self.part_set_header.hash + (self.part_set_header.total & (2**64 - 1)).to_bytes(8, "big")
+        # on hostile input (it sits on the VoteSet.add_vote path — hence the
+        # per-instance memo: a vote storm calls key() several times per vote).
+        k = self.__dict__.get("_key")
+        if k is None:
+            k = (
+                self.hash
+                + self.part_set_header.hash
+                + (self.part_set_header.total & (2**64 - 1)).to_bytes(8, "big")
+            )
+            object.__setattr__(self, "_key", k)
+        return k
 
     def encode(self) -> bytes:
         w = pw.Writer()

@@ -324,6 +324,21 @@ class Commit:
             cs.timestamp_ns,
         )
 
+    def vote_sign_bytes_many(self, chain_id: str, val_idxs) -> list:
+        """Batched vote_sign_bytes over many signature indices — the O(N)
+        commit-verification paths build all their messages in one pass
+        (canonical.vote_sign_bytes_many; profiled ~10x the per-row builder)."""
+        return canonical.vote_sign_bytes_many(
+            chain_id,
+            SignedMsgType.PRECOMMIT,
+            self.height,
+            self.round,
+            (
+                (self.signatures[i].block_id(self.block_id), self.signatures[i].timestamp_ns)
+                for i in val_idxs
+            ),
+        )
+
     def hash(self) -> bytes:
         return hash_from_byte_slices([cs.encode() for cs in self.signatures])
 

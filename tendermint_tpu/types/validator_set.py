@@ -332,16 +332,17 @@ class ValidatorSet:
             raise CommitVerifyError(
                 f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
             )
-        pubkeys, msgs, sigs, meta, key_types = [], [], [], [], []
+        pubkeys, sigs, meta, key_types, idxs = [], [], [], [], []
         for idx, cs in enumerate(commit.signatures):
             if cs.absent():
                 continue
             val = self.validators[idx]
             pubkeys.append(val.pub_key.bytes())
-            msgs.append(commit.vote_sign_bytes(chain_id, idx))
+            idxs.append(idx)
             sigs.append(cs.signature)
             meta.append((idx, val.voting_power, cs.for_block()))
             key_types.append(val.pub_key.type_name())
+        msgs = commit.vote_sign_bytes_many(chain_id, idxs)
         mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
         tallied = 0
         for ok, (idx, power, for_block) in zip(mask, meta):
@@ -371,17 +372,18 @@ class ValidatorSet:
             raise CommitVerifyError(
                 f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
             )
-        pubkeys, msgs, sigs, powers = [], [], [], []
+        pubkeys, sigs, powers, idxs = [], [], [], []
         key_types = []
         for idx, cs in enumerate(commit.signatures):
             if not cs.for_block():
                 continue
             val = self.validators[idx]
             pubkeys.append(val.pub_key.bytes())
-            msgs.append(commit.vote_sign_bytes(chain_id, idx))
+            idxs.append(idx)
             sigs.append(cs.signature)
             powers.append(val.voting_power)
             key_types.append(val.pub_key.type_name())
+        msgs = commit.vote_sign_bytes_many(chain_id, idxs)
         handle = verify_batch_submit(pubkeys, msgs, sigs, key_types=key_types)
 
         def finish() -> None:
@@ -410,7 +412,7 @@ class ValidatorSet:
         total_mul = self.total_voting_power() * trust_level.numerator
         needed = total_mul // trust_level.denominator
         seen: Dict[int, int] = {}
-        pubkeys, msgs, sigs, powers = [], [], [], []
+        pubkeys, sigs, powers, idxs = [], [], [], []
         key_types = []
         for idx, cs in enumerate(commit.signatures):
             if not cs.for_block():
@@ -424,10 +426,11 @@ class ValidatorSet:
                 )
             seen[val_idx] = idx
             pubkeys.append(val.pub_key.bytes())
-            msgs.append(commit.vote_sign_bytes(chain_id, idx))
+            idxs.append(idx)
             sigs.append(cs.signature)
             powers.append(val.voting_power)
             key_types.append(val.pub_key.type_name())
+        msgs = commit.vote_sign_bytes_many(chain_id, idxs)
         handle = verify_batch_submit(pubkeys, msgs, sigs, key_types=key_types)
 
         def finish() -> None:
