@@ -93,7 +93,11 @@ def _register_pytrees() -> None:
 
 
 def enabled() -> bool:
-    return os.environ.get("TMTPU_AOT", "1") != "0" and jax.default_backend() != "cpu"
+    # CPU included since r5: the test suite's kernel lane was retracing
+    # ~400k-eq jaxprs in every process (the dominant cost of `pytest -m
+    # kernel` — XLA compiles were already persistent-cached); export
+    # artifacts are keyed per backend so CPU and TPU never collide.
+    return os.environ.get("TMTPU_AOT", "1") != "0"
 
 
 def call(name: str, jit_fn, *args):
@@ -129,8 +133,24 @@ def _call_locked(name, key, jit_fn, *args):
         path = os.path.join(d, key + ".bin") if d else None
         exp = None
         if path and os.path.exists(path):
-            with open(path, "rb") as f:
-                exp = jexport.deserialize(bytearray(f.read()))
+            try:
+                with open(path, "rb") as f:
+                    exp = jexport.deserialize(bytearray(f.read()))
+            except Exception:
+                # Corrupted artifact: delete it and fall through to a fresh
+                # export — permanently disabling the AOT path for this key
+                # (the old behavior) made every future process repay both
+                # the failed deserialize AND the ~70 s retrace.
+                import logging
+
+                logging.getLogger("tendermint_tpu.ops.aot").warning(
+                    "corrupt AOT artifact %s; deleting and re-exporting", path
+                )
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                exp = None
         if exp is None:
             exp = jexport.export(jit_fn)(*args)
             if path:

@@ -1,0 +1,94 @@
+"""Atomic writes for jax's persistent compilation cache.
+
+Root cause of the round-4 cache corruption: jax's file cache writes
+entries with a plain `cache_path.write_bytes(val)` (jax/_src/lru_cache.py
+LRUCache.put) — NOT atomically. A process killed mid-write (the sharded
+XLA:CPU executables are multi-hundred-MB; round-4 test runs hit 24.7 GB
+RSS and were OOM-killed) leaves a TRUNCATED entry, and the next process
+feeds those bytes straight into XLA's executable deserializer, which
+SIGSEGVs (observed twice in get_executable_and_time). Round 4 worked
+around it by bypassing the persistent cache for sharded kernels entirely
+(_no_persistent_cache), which made every fresh dryrun/test process
+recompile for minutes — the r4 MULTICHIP timeout.
+
+This module fixes the root cause: `harden()` patches LRUCache.put to
+write via tempfile + os.replace (atomic on POSIX), so a killed writer
+leaves only an orphaned .tmp file, never a truncated entry. Call it
+before the first compile in any process that shares a cache directory
+(tests/conftest.py, __graft_entry__, bench.py, parallel/sharded.py).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+_PATCHED = False
+
+
+def _sweep_stale_tmps(path) -> None:
+    """Unlink .put-*.tmp files a killed writer left behind. Only files
+    older than an hour — a younger tmp may be a live concurrent write."""
+    import glob
+    import time
+
+    cutoff = time.time() - 3600
+    for tmp in glob.glob(os.path.join(str(path), ".put-*.tmp")):
+        try:
+            if os.path.getmtime(tmp) < cutoff:
+                os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def harden() -> None:
+    global _PATCHED
+    if _PATCHED:
+        return
+    try:
+        from jax._src import lru_cache as _lru
+    except Exception:  # pragma: no cover - jax internals moved
+        _PATCHED = True
+        return
+
+    orig_put = _lru.LRUCache.put
+
+    def atomic_put(self, key: str, val: bytes) -> None:
+        if not key:
+            raise ValueError("key cannot be empty")
+        if self.eviction_enabled and len(val) > self.max_size:
+            return orig_put(self, key, val)  # let jax warn + skip
+
+        _sweep_stale_tmps(self.path)
+        cache_path = self.path / f"{key}{_lru._CACHE_SUFFIX}"
+        if self.eviction_enabled:
+            self.lock.acquire(timeout=self.lock_timeout_secs)
+        try:
+            if cache_path.exists():
+                return
+            self._evict_if_needed(additional_size=len(val))
+            fd, tmp = tempfile.mkstemp(
+                dir=str(self.path), prefix=".put-", suffix=".tmp"
+            )
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(val)
+                os.replace(tmp, str(cache_path))
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            if self.eviction_enabled:
+                import time as _time
+
+                timestamp = _time.time_ns().to_bytes(8, "little")
+                atime_path = self.path / f"{key}{_lru._ATIME_SUFFIX}"
+                atime_path.write_bytes(timestamp)
+        finally:
+            if self.eviction_enabled:
+                self.lock.release()
+
+    _lru.LRUCache.put = atomic_put
+    _PATCHED = True

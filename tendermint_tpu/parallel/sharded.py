@@ -18,51 +18,22 @@ Two mesh shapes are supported:
 
 from __future__ import annotations
 
-import contextlib
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tendermint_tpu.ops import cache_hardening
 from tendermint_tpu.ops.ed25519_jax import _verify_core, make_ctx, verify_prepared
 
-
-@contextlib.contextmanager
-def _no_persistent_cache():
-    """Serializing the multi-hundred-MB sharded executables through jax's
-    persistent compilation cache crashed the interpreter three times in
-    round 4 (SIGSEGV in put_executable_and_time once, and in
-    get_executable_and_time twice on the poisoned entries it left behind).
-    Sharded kernels therefore never touch the persistent cache — they
-    recompile once per process (paid by test/dryrun processes today; a real
-    multi-chip deployment pays it once at node start, hidden by prewarm).
-    Callers only enter this around the FIRST call per compiled shape, so the
-    global-flag flip (and its small race window against concurrent compiles
-    on other threads) is confined to compile time, not steady state."""
-    # NOTE: flipping config is NOT enough by itself — jax memoizes the
-    # "is the cache used" decision in compilation_cache._cache_used after
-    # the first compile (measured: both the dir-clearing and the bare
-    # enable-flag variants still read/wrote). reset_cache() clears that
-    # memo, making the flag effective.
-    from jax._src import compilation_cache as _cc
-
-    prev = jax.config.jax_enable_compilation_cache
-    try:
-        if prev:
-            jax.config.update("jax_enable_compilation_cache", False)
-            try:
-                _cc.reset_cache()
-            except Exception:
-                pass
-        yield
-    finally:
-        if prev:
-            jax.config.update("jax_enable_compilation_cache", True)
-            try:
-                _cc.reset_cache()
-            except Exception:
-                pass
+# Round 4 bypassed the persistent compile cache for every sharded kernel
+# (SIGSEGV on poisoned entries), which made each fresh dryrun/test process
+# recompile for minutes. Root cause was jax's NON-ATOMIC cache entry write
+# (truncated multi-hundred-MB entries after an OOM-kill mid-put); with
+# atomic tmp+rename writes (ops/cache_hardening.py) the cache is safe to
+# use again — warm sharded processes load their executables in seconds.
+cache_hardening.harden()
 
 
 def make_mesh(devices=None, shape=None, axis_names=("vals",)) -> Mesh:
@@ -136,17 +107,10 @@ def sharded_verify(mesh: Mesh):
             fn = _cache[batch_rank] = jax.jit(_verify)
         return fn
 
-    _warm: set = set()
-
     def run(a, r, s_bits, h_bits):
         shard_batch = _shard_batch_shape(mesh, a.shape[1:])
         rank = len(a.shape) - 1
-        if rank in _warm:
-            return _for_rank(rank)(a, r, s_bits, h_bits, make_ctx(shard_batch))
-        with _no_persistent_cache():
-            out = _for_rank(rank)(a, r, s_bits, h_bits, make_ctx(shard_batch))
-        _warm.add(rank)
-        return out
+        return _for_rank(rank)(a, r, s_bits, h_bits, make_ctx(shard_batch))
 
     return run
 
@@ -195,23 +159,14 @@ def sharded_commit_step(mesh: Mesh):
             fn = _cache[batch_rank] = jax.jit(_step)
         return fn
 
-    _warm: set = set()
-
     def step(a, r, s_bits, h_bits, power_planes):
         import numpy as np
 
         shard_batch = _shard_batch_shape(mesh, a.shape[1:])
         rank = len(a.shape) - 1
-        if rank in _warm:
-            mask, talled, total = _for_rank(rank)(
-                a, r, s_bits, h_bits, power_planes, make_ctx(shard_batch)
-            )
-        else:
-            with _no_persistent_cache():
-                mask, talled, total = _for_rank(rank)(
-                    a, r, s_bits, h_bits, power_planes, make_ctx(shard_batch)
-                )
-            _warm.add(rank)
+        mask, talled, total = _for_rank(rank)(
+            a, r, s_bits, h_bits, power_planes, make_ctx(shard_batch)
+        )
 
         def _join(planes) -> int:
             return sum(int(v) << (16 * k) for k, v in enumerate(np.asarray(planes)))
@@ -293,18 +248,11 @@ def sharded_rlc_check(mesh: Mesh):
             )
         return fn
 
-    _warm: set = set()
-
     def run(pts_bytes, perm, ends):
         if pts_bytes.shape[0] != ndev:
             raise ValueError(f"leading axis {pts_bytes.shape[0]} != mesh size {ndev}")
         n_sh = pts_bytes.shape[2]
-        if n_sh in _warm:
-            bok, ok = _for_lanes(n_sh)(pts_bytes, perm, ends)
-        else:
-            with _no_persistent_cache():
-                bok, ok = _for_lanes(n_sh)(pts_bytes, perm, ends)
-            _warm.add(n_sh)
+        bok, ok = _for_lanes(n_sh)(pts_bytes, perm, ends)
         return bok, ok.reshape(-1)
 
     return run

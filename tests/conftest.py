@@ -45,3 +45,10 @@ jax.config.update(
     ),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+# Atomic cache-entry writes: an OOM-killed test run must never leave a
+# truncated executable for the next process to SIGSEGV on (the r4 failure
+# mode; see ops/cache_hardening.py).
+from tendermint_tpu.ops import cache_hardening  # noqa: E402
+
+cache_hardening.harden()
