@@ -37,6 +37,16 @@ class BaseConfig:
     # proxy/client.go).
     proxy_app: str = ""
     filter_peers: bool = False
+    # Ed25519 verification predicate. Default "cofactored" (ZIP-215-style,
+    # the framework's batch-friendly predicate on every path — see
+    # crypto/ed25519_ref.verify_cofactored). "cofactorless" switches
+    # DEFAULT-routed verification to reference-exact semantics (Go
+    # ed25519.Verify, reference: crypto/ed25519/ed25519.go): host OpenSSL
+    # only, device batch paths disabled for auto-routed calls. REQUIRED
+    # when co-validating with reference (Go) nodes: cofactored accepts a
+    # strict superset (crafted small-torsion signatures), which is a
+    # consensus-fork vector at the 2/3 boundary in a mixed fleet.
+    ed25519_verify_mode: str = "cofactored"
 
 
 @dataclass

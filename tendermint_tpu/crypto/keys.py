@@ -36,6 +36,35 @@ def address_from_pubkey_bytes(pubkey_bytes: bytes) -> bytes:
 
 _P25519 = 2**255 - 19
 
+# Framework-wide Ed25519 verification predicate. "cofactored" (default) is
+# the ZIP-215-style predicate every device path implements natively;
+# "cofactorless" is reference-exact (Go ed25519.Verify, reference:
+# crypto/ed25519/ed25519.go) for mixed fleets that co-validate with
+# reference nodes — cofactored accepts a strict superset (crafted
+# small-torsion signatures), a consensus-fork vector at the 2/3 boundary.
+# In cofactorless mode, DEFAULT-routed batch verification runs on the host
+# (crypto/batch.backend_default); explicitly-requested device backends are
+# honored and stay cofactored (tests/bench). Set via config
+# (base.ed25519_verify_mode), TMTPU_ED25519_MODE, or set_verify_mode().
+_VERIFY_MODE = os.environ.get("TMTPU_ED25519_MODE", "cofactored")
+if _VERIFY_MODE not in ("cofactored", "cofactorless"):
+    # Fail fast: a typo'd mode silently running the default would be the
+    # exact consensus-fork hazard the flag exists to close.
+    raise ValueError(
+        f"TMTPU_ED25519_MODE={_VERIFY_MODE!r} is not 'cofactored' or 'cofactorless'"
+    )
+
+
+def set_verify_mode(mode: str) -> None:
+    global _VERIFY_MODE
+    if mode not in ("cofactored", "cofactorless"):
+        raise ValueError(f"unknown ed25519 verify mode {mode!r}")
+    _VERIFY_MODE = mode
+
+
+def cofactorless_mode() -> bool:
+    return _VERIFY_MODE == "cofactorless"
+
 
 def _canonical_y(enc: bytes) -> bool:
     """True iff the 32-byte point encoding's y coordinate is canonical
@@ -117,6 +146,21 @@ class Ed25519PubKey(PubKey):
         batches ride the device per-sig kernel, not this wrapper."""
         if len(sig) != SIGNATURE_SIZE:
             return False
+        if cofactorless_mode():
+            # Reference-exact: delegate ENTIRELY to OpenSSL, including the
+            # canonical-encoding prechecks — OpenSSL's ref10-lineage
+            # acceptance set matches the reference's golang.org/x/crypto
+            # (non-canonical A accepted, non-canonical R rejected by the
+            # R-encoding comparison, s < L enforced). Running our canonical
+            # precheck here would itself be a divergence (we'd reject
+            # non-canonical A that reference peers accept). Non-canonical
+            # VALIDATOR keys are still blocked in both modes at ingestion
+            # (pubkey_from_type_and_bytes).
+            try:
+                Ed25519PublicKey.from_public_bytes(self.key_bytes).verify(sig, msg)
+                return True
+            except (InvalidSignature, ValueError):
+                return False
         if not (_canonical_y(self.key_bytes) and _canonical_y(sig[:32])):
             return False
         try:

@@ -65,6 +65,15 @@ class Node:
     ):
         self.config = config
         self.genesis = genesis
+        # Apply the chain's verification predicate before any key is checked
+        # (cofactorless = reference-exact interop mode; see config.BaseConfig
+        # and crypto/keys.set_verify_mode). Unconditional: the mode is
+        # process-global, so a "cofactored" config must actively reset any
+        # "cofactorless" left by env or an earlier Node in this process
+        # (and set_verify_mode validates the string either way).
+        from tendermint_tpu.crypto.keys import set_verify_mode
+
+        set_verify_mode(getattr(config.base, "ed25519_verify_mode", "cofactored"))
         self._owns_priv_validator = False
         if priv_validator is None and config.base.priv_validator_addr:
             # dial the remote signer (reference: node/node.go:658

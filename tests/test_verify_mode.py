@@ -1,0 +1,102 @@
+"""The ed25519 verification-predicate switch (config base.ed25519_verify_mode).
+
+Default "cofactored" accepts ZIP-215-style torsion-defect signatures on
+every path; "cofactorless" is reference-exact (Go ed25519.Verify,
+reference: crypto/ed25519/ed25519.go) and routes default batch
+verification to the host so a mixed fleet with reference nodes cannot
+fork on crafted small-torsion inputs (advisor r4 medium)."""
+
+import pytest
+
+from tendermint_tpu.crypto import batch as B
+from tendermint_tpu.crypto import keys
+from tests.sigutil import torsion_defect_sig
+
+
+@pytest.fixture
+def _restore_mode():
+    yield
+    keys.set_verify_mode("cofactored")
+
+
+def test_cofactored_default_accepts_torsion_defect():
+    pk, msg, sig = torsion_defect_sig()
+    assert keys.Ed25519PubKey(pk).verify(msg, sig)
+
+
+def test_cofactorless_mode_rejects_torsion_defect(_restore_mode):
+    pk, msg, sig = torsion_defect_sig()
+    keys.set_verify_mode("cofactorless")
+    assert not keys.Ed25519PubKey(pk).verify(msg, sig)
+    # honest signatures still verify
+    priv = keys.gen_ed25519(b"\x11" * 32)
+    assert priv.pub_key().verify(b"honest", priv.sign(b"honest"))
+
+
+def test_cofactorless_mode_routes_batches_to_host(_restore_mode):
+    keys.set_verify_mode("cofactorless")
+    assert B.backend_default() == "cpu"
+    pk, msg, sig = torsion_defect_sig()
+    mask = B.verify_batch([pk], [msg], [sig])
+    assert not mask[0]
+    keys.set_verify_mode("cofactored")
+    mask = B.verify_batch([pk], [msg], [sig], backend="cpu")
+    assert mask[0]
+
+
+def test_set_verify_mode_validates():
+    with pytest.raises(ValueError):
+        keys.set_verify_mode("bogus")
+
+
+def test_env_mode_validated_at_import():
+    import subprocess
+    import sys
+
+    p = subprocess.run(
+        [sys.executable, "-c", "import tendermint_tpu.crypto.keys"],
+        env={"TMTPU_ED25519_MODE": "Cofactorless", "PATH": "/usr/bin:/bin"},
+        capture_output=True,
+        text=True,
+        cwd=".",
+    )
+    assert p.returncode != 0
+    assert "TMTPU_ED25519_MODE" in p.stderr
+
+
+def test_cofactorless_delegates_prechecks_to_openssl(_restore_mode, monkeypatch):
+    """Reference-exact mode must NOT run our canonical-encoding precheck
+    (x/crypto accepts non-canonical A; our precheck would reject it — the
+    divergence the mode exists to close). Cofactored mode still runs it."""
+    keys.set_verify_mode("cofactorless")
+    priv = keys.gen_ed25519(b"\x13" * 32)
+    sig = priv.sign(b"delegate")
+
+    def boom(enc):
+        raise AssertionError("canonical precheck must not run in cofactorless mode")
+
+    monkeypatch.setattr(keys, "_canonical_y", boom)
+    assert priv.pub_key().verify(b"delegate", sig)
+    keys.set_verify_mode("cofactored")
+    with pytest.raises(AssertionError):
+        priv.pub_key().verify(b"delegate", sig)
+
+
+def test_node_resets_poisoned_global_mode(tmp_path):
+    """A Node whose config says 'cofactored' must actively reset a
+    process-global 'cofactorless' left by an earlier Node or env (the
+    guard used to be one-way: it only SET cofactorless, never cleared it)."""
+    from tests.test_multinode import make_net
+
+    keys.set_verify_mode("cofactorless")
+    try:
+        make_net(1, tmp_path, chain="mode-reset-chain")
+        assert not keys.cofactorless_mode()
+    finally:
+        keys.set_verify_mode("cofactored")
+
+
+def test_node_config_field_default():
+    from tendermint_tpu.config.config import Config
+
+    assert Config().base.ed25519_verify_mode == "cofactored"
