@@ -151,10 +151,21 @@ class WSSubscription:
         self._id = sub_id
         self.query = query
         self._queue: asyncio.Queue = asyncio.Queue()
+        self._terminal: Optional[Exception] = None
 
     async def next(self) -> dict:
+        # A dead subscription must fail EVERY next() call, not just the one
+        # that drained the single enqueued error: later (or concurrent)
+        # consumers would otherwise await an empty queue forever (advisor r4).
+        if self._terminal is not None and self._queue.empty():
+            raise self._terminal
         item = await self._queue.get()
         if isinstance(item, Exception):
+            self._terminal = item
+            # Re-enqueue the sentinel so consumers ALREADY parked in
+            # queue.get() (which never saw the empty-queue precheck above)
+            # wake in a chain instead of awaiting forever.
+            self._queue.put_nowait(item)
             raise item
         return item
 
