@@ -916,8 +916,34 @@ def _verify_batch_mixed_exact(
             backend,
         )
         out[ed_idx] = sub
-    for i in sr_idx:
-        out[i] = sr25519_verify(bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i]))
+    if sr_idx:
+        from tendermint_tpu import native
+
+        # Length pre-filter BEFORE packing: upstream ValidateBasic only
+        # bounds signatures at <= 64 bytes, and a short row would misalign
+        # the fixed-stride blobs (corrupting every later verdict and
+        # reading past the buffer). Mirrors native.sr25519_verify's check.
+        sr_ok = [
+            i for i in sr_idx if len(bytes(sigs[i])) == 64 and len(bytes(pubkeys[i])) == 32
+        ]
+        if sr_ok and native.available():
+            # one multithreaded native call instead of a per-sig loop
+            srm = [bytes(msgs[i]) for i in sr_ok]
+            moffs = np.zeros(len(sr_ok) + 1, dtype=np.int64)
+            np.cumsum(
+                np.fromiter(map(len, srm), dtype=np.int64, count=len(srm)),
+                out=moffs[1:],
+            )
+            mask = native.sr25519_verify_batch(
+                b"".join(bytes(pubkeys[i]) for i in sr_ok),
+                b"".join(srm),
+                moffs,
+                b"".join(bytes(sigs[i]) for i in sr_ok),
+            )
+            out[sr_ok] = mask
+        else:
+            for i in sr_ok:
+                out[i] = sr25519_verify(bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i]))
     return out
 
 

@@ -113,7 +113,22 @@ def _context_transcript(msg: bytes) -> Transcript:
 
 
 def sr25519_verify(pub_bytes: bytes, msg: bytes, sig: bytes) -> bool:
-    """(reference: crypto/sr25519/pubkey.go:34 VerifySignature)"""
+    """(reference: crypto/sr25519/pubkey.go:34 VerifySignature)
+
+    Routes to the native C verifier (tendermint_tpu/native/sr25519.c,
+    ~100 us/sig) when available; this pure-Python path (~5-10 ms/sig) is
+    the fallback and the differential-test reference."""
+    if len(sig) != 64 or len(pub_bytes) != 32:
+        return False
+    from tendermint_tpu import native
+
+    if native.available():
+        return native.sr25519_verify(bytes(pub_bytes), bytes(msg), bytes(sig))
+    return _sr25519_verify_py(pub_bytes, msg, sig)
+
+
+def _sr25519_verify_py(pub_bytes: bytes, msg: bytes, sig: bytes) -> bool:
+    """Pure-Python schnorrkel verification (reference semantics)."""
     if len(sig) != 64 or len(pub_bytes) != 32:
         return False
     if not (sig[63] & 0x80):

@@ -33,28 +33,38 @@ _NTHREADS = min(8, os.cpu_count() or 1)
 
 
 def _build() -> "ctypes.CDLL | None":
-    src = os.path.join(_BASE, "batchhost.c")
-    with open(src, "rb") as f:
-        src_bytes = f.read()
-    tag = hashlib.sha256(src_bytes).hexdigest()[:16]
+    srcs = [os.path.join(_BASE, "batchhost.c"), os.path.join(_BASE, "sr25519.c")]
+    h = hashlib.sha256()
+    # gen_constants.py is IN the tag: the generated headers carry curve
+    # constants the verifier's correctness depends on, so an edit to the
+    # generator must invalidate both the cached .so and the cached headers.
+    for src in srcs + [os.path.join(_BASE, "gen_constants.py")]:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:16]
     build_dir = os.path.join(_BASE, "_build")
     so_path = os.path.join(build_dir, f"batchhost-{tag}.so")
     if not os.path.exists(so_path):
         os.makedirs(build_dir, exist_ok=True)
-        hdr = os.path.join(build_dir, "sha512_constants.h")
-        if not os.path.exists(hdr):
-            from tendermint_tpu.native.gen_constants import generate
+        from tendermint_tpu.native.gen_constants import generate, generate_ed
 
+        for hdr_name, gen in [
+            ("sha512_constants.h", generate),
+            ("ed25519_constants.h", generate_ed),
+        ]:
+            # regenerate whenever the .so for this tag is missing (headers
+            # are cheap; existence-caching kept stale constants alive)
+            hdr = os.path.join(build_dir, hdr_name)
             fd, tmp = tempfile.mkstemp(dir=build_dir, prefix=".hdr-")
             with os.fdopen(fd, "w") as f:
-                f.write(generate())
+                f.write(gen())
             os.replace(tmp, hdr)
         fd, tmp = tempfile.mkstemp(dir=build_dir, prefix=".so-", suffix=".so")
         os.close(fd)
         cc = os.environ.get("CC", "gcc")
         cmd = [
             cc, "-O3", "-shared", "-fPIC", "-pthread",
-            "-I", build_dir, src, "-o", tmp,
+            "-I", build_dir, *srcs, "-o", tmp,
         ]
         try:
             subprocess.run(
@@ -79,6 +89,9 @@ def _build() -> "ctypes.CDLL | None":
     lib.tm_ed25519_h_batch.argtypes = [u8p, u8p, u8p, i64p, ctypes.c_int64, u8p, ctypes.c_int]
     lib.tm_rlc_scalars.argtypes = [u8p, u8p, u8p, ctypes.c_int64, u8p, u8p, ctypes.c_int]
     lib.tm_sort_windows.argtypes = [u8p, ctypes.c_int64, i32p, i32p, ctypes.c_int]
+    lib.tm_sr25519_verify_one.argtypes = [u8p, u8p, ctypes.c_int64, u8p]
+    lib.tm_sr25519_verify_one.restype = ctypes.c_int
+    lib.tm_sr25519_verify_batch.argtypes = [u8p, u8p, i64p, u8p, ctypes.c_int64, u8p, ctypes.c_int]
     return lib
 
 
@@ -148,6 +161,39 @@ def rlc_scalars(z16: np.ndarray, h32: np.ndarray, s32: np.ndarray):
     s32 = np.ascontiguousarray(s32, dtype=np.uint8)
     lib.tm_rlc_scalars(_u8p(z16), _u8p(h32), _u8p(s32), n, _u8p(w), _u8p(u), _NTHREADS)
     return w, int.from_bytes(u.tobytes(), "little")
+
+
+def sr25519_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Native schnorrkel verification (see sr25519.c; mirrors
+    crypto/sr25519.sr25519_verify bit-for-bit, differentially tested)."""
+    lib = _lib()
+    assert lib is not None
+    if len(pub) != 32 or len(sig) != 64:
+        return False
+    p = np.frombuffer(pub, dtype=np.uint8)
+    m = np.frombuffer(msg, dtype=np.uint8) if msg else np.zeros(1, np.uint8)
+    s = np.frombuffer(sig, dtype=np.uint8)
+    return bool(lib.tm_sr25519_verify_one(_u8p(p), _u8p(m), len(msg), _u8p(s)))
+
+
+def sr25519_verify_batch(
+    pks_blob: bytes, msgs_blob: bytes, moffs: np.ndarray, sigs_blob: bytes
+) -> np.ndarray:
+    """Batched native schnorrkel verification -> bool mask (n,)."""
+    lib = _lib()
+    assert lib is not None
+    n = len(moffs) - 1
+    out = np.empty(n, dtype=np.uint8)
+    pks = np.frombuffer(pks_blob, dtype=np.uint8)
+    sigs = np.frombuffer(sigs_blob, dtype=np.uint8)
+    msgs = np.frombuffer(msgs_blob, dtype=np.uint8) if msgs_blob else np.zeros(1, np.uint8)
+    moffs = np.ascontiguousarray(moffs, dtype=np.int64)
+    lib.tm_sr25519_verify_batch(
+        _u8p(pks), _u8p(msgs),
+        moffs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        _u8p(sigs), n, _u8p(out), _NTHREADS,
+    )
+    return out.astype(bool)
 
 
 def sort_windows(digits: np.ndarray):

@@ -199,7 +199,7 @@ static void add_limbs(uint64_t *a, const uint64_t *b, int n) {
 
 /* X (8 limbs, < 2^512) mod L -> r (4 limbs).
  * Fold 2^252 === -C three times, then fix up with +2*4L and subtract L. */
-static void mod_l_512(const uint64_t *x, uint64_t *r) {
+void tm_mod_l_512(const uint64_t *x, uint64_t *r) {
   /* hi2 needs 4 limbs: shr_limbs(a1+3, 4, ...) writes 4 (the top one is
    * always 0 since a1 < 2^385, but the WRITE happens regardless). */
   uint64_t hi1[5], lo1[4], a1[7], hi2[4], lo2[4], a2[5], lo3[4], a3[3];
@@ -279,7 +279,7 @@ static void *hash_worker(void *arg) {
     sha512_3(j->sigs + 64 * i, 32, j->pks + 32 * i, 32, j->msgs + j->moffs[i],
              (size_t)(j->moffs[i + 1] - j->moffs[i]), digest);
     load_le(digest, 64, x, 8);
-    mod_l_512(x, r);
+    tm_mod_l_512(x, r);
     store_le(r, 4, j->out + 32 * i, 32);
   }
   return 0;
@@ -366,7 +366,7 @@ void tm_rlc_scalars(const uint8_t *z, const uint8_t *h, const uint8_t *s,
   uint64_t total[8] = {0};
   for (int t = 0; t < used; t++) add_limbs(total, jobs[t].acc, 8);
   uint64_t u[4];
-  mod_l_512(total, u);
+  tm_mod_l_512(total, u);
   store_le(u, 4, u_out, 32);
 }
 

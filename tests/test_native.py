@@ -128,6 +128,61 @@ def test_precheck_and_hash_fast_matches_python():
         assert int.from_bytes(h_c[i].tobytes(), "little") == hk_ints[i]
 
 
+def test_sr25519_native_matches_python():
+    """Native C schnorrkel verifier (sr25519.c) vs the pure-Python reference
+    on valid, tampered, wrong-key, marker-bit and s-range inputs."""
+    native = _native()
+    from tendermint_tpu.crypto.sr25519 import L as SR_L
+    from tendermint_tpu.crypto.sr25519 import _sr25519_verify_py, gen_sr25519
+
+    pks, msgs, sigs = [], [], []
+    for i in range(12):
+        priv = gen_sr25519(bytes([i + 1]) * 32)
+        m = b"sr-diff-%02d" % i + b"y" * (i * 7)
+        pks.append(priv.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(priv.sign(m))
+    cases = [(pks[i], msgs[i], sigs[i], True) for i in range(12)]
+    cases += [
+        (pks[0], msgs[0], bytes([sigs[0][0] ^ 1]) + sigs[0][1:], False),
+        (pks[1], b"wrong", sigs[1], False),
+        (pks[2], msgs[3], sigs[3], False),  # wrong key
+        (pks[4], msgs[4], sigs[4][:63] + bytes([sigs[4][63] & 0x7F]), False),  # no marker
+        (pks[5], msgs[5], sigs[5][:32] + SR_L.to_bytes(32, "little")[:31] + bytes([0x90]), False),  # s >= L
+        (bytes(32), msgs[6], sigs[6], True),  # identity-ish pubkey: decode decides
+    ]
+    for i, (pk, m, s, expect_valid) in enumerate(cases):
+        c = native.sr25519_verify(pk, m, s)
+        p = _sr25519_verify_py(pk, m, s)
+        assert c == p, (i, c, p)
+        if expect_valid and i < 12:
+            assert c
+
+
+def test_sr25519_native_batch_matches_one():
+    native = _native()
+    from tendermint_tpu.crypto.sr25519 import gen_sr25519
+
+    n = 16
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        priv = gen_sr25519(bytes([40 + i]) * 32)
+        m = b"batch-%02d" % i * (i + 1)
+        pks.append(priv.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(priv.sign(m))
+    sigs[5] = bytes(64)  # invalid row
+    moffs = np.zeros(n + 1, dtype=np.int64)
+    for i, m in enumerate(msgs):
+        moffs[i + 1] = moffs[i] + len(m)
+    mask = native.sr25519_verify_batch(
+        b"".join(pks), b"".join(msgs), moffs, b"".join(sigs)
+    )
+    for i in range(n):
+        assert mask[i] == native.sr25519_verify(pks[i], msgs[i], sigs[i]), i
+    assert mask.sum() == n - 1 and not mask[5]
+
+
 def test_verify_batch_jax_native_end_to_end():
     """The full RLC path with native host prep verifies real signatures and
     rejects a corrupted one (fallback path)."""
