@@ -67,6 +67,8 @@ from __future__ import annotations
 
 from typing import NamedTuple, Sequence, Tuple
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -640,6 +642,40 @@ def _rlc_core_cached(
     return jnp.concatenate([bok[None], r_ok])
 
 
+def sort_windows_device(digits: jnp.ndarray):
+    """In-graph per-window sort: digits (N, T) uint8 -> perm (T, N) int32,
+    ends (T, NBUCKETS) int32 — the device-side twin of sort_windows.
+
+    Why on device: the host counting sort is ~18 ms single-threaded at
+    20k lanes AND the perm it produces is 2x the wire size of the digits
+    it's derived from ((T,N) uint16 = 1.3 MB vs (N,T) uint8 = 655 KB at
+    ~20-40 MB/s H2D). Sorting in-graph removes both. Stability is NOT
+    required: bucket sums and Fenwick prefixes depend only on the SET of
+    lanes at each digit value, never on intra-bucket order."""
+    d_t = digits.T  # (T, N)
+    perm = jnp.argsort(d_t, axis=1).astype(jnp.int32)
+    sorted_d = jnp.take_along_axis(d_t, perm, axis=1)
+    vals = jnp.arange(NBUCKETS, dtype=sorted_d.dtype)
+    ends = jax.vmap(
+        lambda row: jnp.searchsorted(row, vals, side="right")
+    )(sorted_d).astype(jnp.int32)
+    return perm, ends
+
+
+def _rlc_core_cached_dsort(
+    ax, ay, az, at,  # (20, Na) predecompressed A block (incl. B lane)
+    r_bytes,  # (32, Nr) uint8
+    digits,  # (Na+Nr, T) uint8 scalar digit rows (window w = byte w)
+    fctx: FieldCtx,  # at shape (Nr,)
+    C: SmallCtx,
+) -> jnp.ndarray:
+    """_rlc_core_cached with the window sort in-graph (sort_windows_device):
+    the host sends raw scalar digit rows; perm/ends/Fenwick nodes are all
+    derived on device."""
+    perm, ends = sort_windows_device(digits)
+    return _rlc_core_cached(ax, ay, az, at, r_bytes, perm, ends, fctx, C)
+
+
 def _rlc_core_cached_mixed(
     ax, ay, az, at,  # (20, Na) predecoded A block (incl. B lane, both key types)
     ed_r_bytes,  # (32, Ne) uint8 — ed25519 R encodings
@@ -674,7 +710,19 @@ def _rlc_core_cached_mixed(
 
 _rlc_jit = jax.jit(_rlc_core)
 _rlc_cached_jit = jax.jit(_rlc_core_cached)
+_rlc_cached_dsort_jit = jax.jit(_rlc_core_cached_dsort)
 _rlc_cached_mixed_jit = jax.jit(_rlc_core_cached_mixed)
+
+
+def _device_sort_enabled() -> bool:
+    # Default OFF: slope-measured 58.0 ms/commit at 10k vs 52.7 ms with the
+    # host counting sort (TPU v5e through the tunnel) — the in-graph
+    # argsort+searchsorted costs more than the 18 ms host sort + extra
+    # 0.7 MB H2D it removes. Kept selectable for hosts where the tradeoff
+    # flips (slow host CPU, faster interconnect). Scope: the pure-ed25519
+    # cached path only — the mixed ed25519+sr25519 kernel always uses the
+    # host sort.
+    return os.environ.get("TMTPU_DEVICE_SORT", "0") != "0"
 
 
 def basepoint_coords() -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -732,8 +780,19 @@ def rlc_check_cached_submit(
     nr = r_bytes.shape[0]
     n = na + nr
     digits = scalars_to_bytes(scalars, n)
-    perm, ends = sort_windows(digits)
     fctx = make_ctx((nr,))
+    if _device_sort_enabled():
+        # digits go down raw; perm/ends are derived in-graph
+        # (sort_windows_device) — no host sort, half the wire bytes.
+        return aot_cache.call(
+            "rlc_cached_ds", _rlc_cached_dsort_jit,
+            *a_coords,
+            np.ascontiguousarray(r_bytes.T),
+            digits,
+            fctx,
+            make_small_ctx(),
+        )
+    perm, ends = sort_windows(digits)
     return aot_cache.call(
         "rlc_cached", _rlc_cached_jit,
         *a_coords,

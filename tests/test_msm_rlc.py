@@ -189,3 +189,55 @@ def test_rlc_accepts_pure_torsion_defect_no_fallback(rlc_on):
     mask = B.verify_batch_jax(pubkeys, msgs, sigs)
     assert mask.all()
     assert B.LAST_JAX_PATH[0] == "rlc"  # combined check passed, no fallback
+
+
+def test_device_sort_matches_host_sort():
+    """sort_windows_device must produce identical `ends` and a
+    bucket-equivalent `perm` (same lane SET per digit bucket — intra-bucket
+    order is free, bucket sums are commutative)."""
+    import jax
+
+    from tendermint_tpu.ops import msm_jax
+
+    rng = np.random.default_rng(21)
+    for n in (5, 130, 1024):
+        digits = rng.integers(0, 256, size=(n, msm_jax.NWIN), dtype=np.uint8)
+        perm_h, ends_h = msm_jax.sort_windows(digits)
+        perm_d, ends_d = jax.jit(msm_jax.sort_windows_device)(digits)
+        perm_d, ends_d = np.asarray(perm_d), np.asarray(ends_d)
+        assert (ends_d == ends_h.astype(np.int64)).all()
+        for w in range(msm_jax.NWIN):
+            # same multiset of lanes inside every bucket
+            start = 0
+            for v in range(msm_jax.NBUCKETS):
+                end = ends_h[w, v]
+                assert set(perm_h[w, start:end].tolist()) == set(
+                    perm_d[w, start:end].tolist()
+                ), (w, v)
+                start = end
+
+
+def test_rlc_device_sort_variant_matches_host_sort_variant(rlc_on, monkeypatch):
+    """The dsort kernel (digits in, sort in-graph) and the host-sorted kernel
+    return the same packed verdict on valid and tampered batches — and the
+    dsort kernel's ACCEPT path works (no silent always-fallback: a valid
+    batch must pass the combined check, not fall back per-sig)."""
+    pubkeys, msgs, sigs = make_batch(24, seed=3)
+
+    # valid batch first: the device-sorted combined check itself must accept
+    monkeypatch.setenv("TMTPU_DEVICE_SORT", "1")
+    B._A_CACHE.clear()
+    B.verify_batch_jax(pubkeys, msgs, sigs)  # fill A cache
+    mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+    assert mask.all()
+    assert B.LAST_JAX_PATH[0] == "rlc", B.LAST_JAX_PATH
+
+    sigs[5] = sigs[5][:10] + bytes([sigs[5][10] ^ 1]) + sigs[5][11:]
+    masks = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("TMTPU_DEVICE_SORT", flag)
+        B._A_CACHE.clear()
+        B.verify_batch_jax(pubkeys, msgs, sigs)  # fill A cache
+        masks[flag] = B.verify_batch_jax(pubkeys, msgs, sigs)  # cached path
+    assert (masks["1"] == masks["0"]).all()
+    assert not masks["1"][5] and masks["1"].sum() == 23
