@@ -608,6 +608,22 @@ def main(argv=None) -> int:
     sp.add_argument("--output", default="debug_dump.zip")
 
     sp = sub.add_parser(
+        "load-test",
+        help="tx load generator: spam a running net over RPC, report send + commit throughput",
+    )
+    sp.add_argument(
+        "--endpoints", default="http://127.0.0.1:26657",
+        help="comma-separated RPC base URLs",
+    )
+    sp.add_argument("--rate", type=float, default=200.0, help="aggregate target tx/s")
+    sp.add_argument("--duration", type=float, default=10.0, help="send window seconds")
+    sp.add_argument("--connections", type=int, default=2, help="workers per endpoint")
+    sp.add_argument("--tx-size", type=int, default=64, help="tx bytes (unique prefix + pad)")
+    sp.add_argument("--method", default="async", choices=("async", "sync"))
+    sp.add_argument("--settle", type=float, default=2.0,
+                    help="post-send wait before counting committed txs")
+
+    sp = sub.add_parser(
         "abci", help="abci-cli console: drive an ABCI app (conformance tool)"
     )
     sp.add_argument(
@@ -697,6 +713,23 @@ def main(argv=None) -> int:
     elif args.cmd == "debug":
         debug_dump(args.home, args.rpc, args.output)
         print(json.dumps({"dump": args.output}))
+    elif args.cmd == "load-test":
+        # in-tree equivalent of the external tm-load-test harness the
+        # reference README delegates to (reference: README.md:153-155)
+        from tendermint_tpu.tools.loadtest import run_load
+
+        report = asyncio.run(
+            run_load(
+                [e.strip() for e in args.endpoints.split(",") if e.strip()],
+                rate=args.rate,
+                duration=args.duration,
+                connections=args.connections,
+                tx_size=args.tx_size,
+                method=args.method,
+                settle=args.settle,
+            )
+        )
+        print(json.dumps(report))
     elif args.cmd == "abci":
         from tendermint_tpu.cli.abci_console import main as abci_main
 

@@ -120,6 +120,9 @@ class HTTPClient:
     async def block_search(self, query: str, page: int = 1, per_page: int = 30):
         return await self.call("block_search", query=query, page=page, per_page=per_page)
 
+    async def broadcast_tx_async(self, tx: bytes):
+        return await self.call("broadcast_tx_async", tx="0x" + tx.hex())
+
     async def broadcast_tx_sync(self, tx: bytes):
         return await self.call("broadcast_tx_sync", tx="0x" + tx.hex())
 

@@ -1,0 +1,6 @@
+"""Operational tooling: load generation (tools/loadtest.py).
+
+The reference delegates load testing to the external tm-load-test project
+(reference: README.md:153-155); this package ships the equivalent in-tree
+so the framework is self-contained.
+"""

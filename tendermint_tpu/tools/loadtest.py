@@ -1,0 +1,176 @@
+"""Transaction load generator driving live consensus over RPC.
+
+The reference ships no in-tree load tool — its README points at the
+external tm-load-test harness (reference: README.md:153-155), which spawns
+websocket/HTTP clients that spam transactions at a running network and
+report send/commit throughput. This is the in-tree equivalent: N asyncio
+workers per endpoint push unique transactions at a target aggregate rate
+through `broadcast_tx_async`/`broadcast_tx_sync`, while the chain's block
+stream is sampled before and after to count what actually COMMITTED —
+send-side acceptance alone (what a naive load tool reports) says nothing
+about consensus keeping up.
+
+Output: one dict/JSON with send-side stats (sent, errors, achieved rate,
+RPC latency percentiles) and chain-side stats (blocks, committed txs,
+committed tx/s, blocks/s) over the run window.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List
+
+from tendermint_tpu.rpc.client import HTTPClient
+
+
+@dataclass
+class LoadStats:
+    sent: int = 0
+    errors: int = 0
+    rejected: int = 0  # CheckTx code != 0 (sync method only)
+    latencies_ms: List[float] = field(default_factory=list)
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(q * (len(xs) - 1)))
+    return xs[i]
+
+
+async def _worker(
+    client: HTTPClient,
+    stats: LoadStats,
+    stop_at: float,
+    interval: float,
+    tx_size: int,
+    method: str,
+    tag: bytes,
+) -> None:
+    """One connection: sends at 1/interval tx/s until stop_at. Each tx is
+    unique (tag + counter + random pad) so the mempool cache never dedups
+    the load away."""
+    i = 0
+    next_send = time.perf_counter()
+    while True:
+        now = time.perf_counter()
+        if now >= stop_at:
+            return
+        if now < next_send:
+            await asyncio.sleep(min(next_send - now, stop_at - now))
+            continue
+        next_send += interval
+        # unique regardless of tx_size: an 8-byte nonce rides every tx (the
+        # counter alone would repeat across runs and the mempool cache would
+        # dedup run 2 to zero committed); pad with random to the target size
+        body = tag + b"=%d;" % i + os.urandom(8)
+        tx = body + os.urandom(max(0, tx_size - len(body)))
+        i += 1
+        t0 = time.perf_counter()
+        try:
+            if method == "sync":
+                res = await client.broadcast_tx_sync(tx)
+                if int(res.get("code", 0)) != 0:
+                    stats.rejected += 1
+                    continue
+            else:
+                await client.broadcast_tx_async(tx)
+            stats.sent += 1
+            stats.latencies_ms.append((time.perf_counter() - t0) * 1e3)
+        except Exception:
+            stats.errors += 1
+
+
+async def run_load(
+    endpoints: List[str],
+    rate: float = 200.0,
+    duration: float = 10.0,
+    connections: int = 2,
+    tx_size: int = 64,
+    method: str = "async",
+    settle: float = 2.0,
+) -> dict:
+    """Drive `rate` tx/s aggregate across endpoints for `duration` seconds,
+    then wait `settle` seconds and count committed txs by scanning the
+    blocks produced in the window."""
+    if method not in ("async", "sync"):
+        raise ValueError(f"method must be 'async' or 'sync', not {method!r}")
+    if not endpoints:
+        raise ValueError("no RPC endpoints given")
+    clients = [HTTPClient(ep) for ep in endpoints]
+    try:
+        status0 = await clients[0].status()
+        h0 = int(status0["sync_info"]["latest_block_height"])
+
+        n_workers = max(1, connections) * len(clients)
+        interval = n_workers / max(rate, 0.001)
+        stop_at = time.perf_counter() + duration
+        stats = [LoadStats() for _ in range(n_workers)]
+        tasks = []
+        w = 0
+        for c in clients:
+            for _ in range(max(1, connections)):
+                tasks.append(
+                    asyncio.ensure_future(
+                        _worker(
+                            c, stats[w], stop_at, interval, tx_size, method,
+                            b"load-%d" % w,
+                        )
+                    )
+                )
+                w += 1
+        t0 = time.perf_counter()
+        await asyncio.gather(*tasks)
+        send_wall = time.perf_counter() - t0
+        if settle > 0:
+            await asyncio.sleep(settle)
+
+        status1 = await clients[0].status()
+        h1 = int(status1["sync_info"]["latest_block_height"])
+        # count only OUR txs (unique "load-N=" prefix): a net with background
+        # traffic must not inflate the committed numbers. Blocks fetched
+        # concurrently in chunks (serial per-height awaits add one RTT per
+        # block to the report time).
+        import base64
+
+        committed = 0
+        heights = list(range(h0 + 1, h1 + 1))
+        for c0 in range(0, len(heights), 32):
+            blocks = await asyncio.gather(
+                *(clients[0].block(height=h) for h in heights[c0 : c0 + 32])
+            )
+            for blk in blocks:
+                for tx_b64 in blk["block"]["data"]["txs"]:
+                    if base64.b64decode(tx_b64).startswith(b"load-"):
+                        committed += 1
+
+        sent = sum(s.sent for s in stats)
+        lats = [x for s in stats for x in s.latencies_ms]
+        return {
+            "endpoints": len(endpoints),
+            "connections_per_endpoint": max(1, connections),
+            "method": method,
+            "tx_size": tx_size,
+            "target_rate": rate,
+            "duration_s": round(send_wall, 2),
+            "sent": sent,
+            "errors": sum(s.errors for s in stats),
+            "rejected": sum(s.rejected for s in stats),
+            "send_rate_tx_s": round(sent / send_wall, 1) if send_wall else 0.0,
+            "rpc_latency_ms_p50": round(_percentile(lats, 0.50), 2),
+            "rpc_latency_ms_p95": round(_percentile(lats, 0.95), 2),
+            "blocks": h1 - h0,
+            "blocks_per_sec": round((h1 - h0) / (send_wall + settle), 2),
+            "committed_txs": committed,
+            "committed_tx_s": round(committed / (send_wall + settle), 1),
+        }
+    finally:
+        for c in clients:
+            try:
+                await c.close()
+            except Exception:
+                pass

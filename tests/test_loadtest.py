@@ -1,0 +1,66 @@
+"""tools/loadtest.py — the in-tree tm-load-test equivalent
+(reference: README.md:153-155 delegates load testing to that external
+project). A single-validator node with a live RPC server takes a short
+storm; the report must show sends AND chain-side commits."""
+
+import asyncio
+import socket
+
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.config.config import test_config
+from tendermint_tpu.crypto.keys import gen_ed25519
+from tendermint_tpu.node.node import Node
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.tools.loadtest import run_load
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _make_node(tmp_path, port):
+    cfg = test_config()
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = f"tcp://127.0.0.1:{port}"
+    cfg.root_dir = ""
+    cfg.consensus.wal_path = str(tmp_path / "wal")
+    priv = FilePV(gen_ed25519(b"\x77" * 32))
+    gen = GenesisDoc(
+        chain_id="load-chain",
+        validators=[GenesisValidator(priv.get_pub_key(), 10)],
+    )
+    return Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
+
+
+def test_load_generator_commits_txs(tmp_path):
+    async def run():
+        port = _free_port()
+        node = _make_node(tmp_path, port)
+        await node.start()
+        try:
+            await node.wait_for_height(1, timeout=60)
+            report = await run_load(
+                [f"http://127.0.0.1:{port}"],
+                rate=150.0,
+                duration=2.0,
+                connections=2,
+                tx_size=48,
+                method="sync",
+                settle=1.5,
+            )
+            assert report["sent"] > 50, report
+            assert report["errors"] == 0, report
+            assert report["committed_txs"] > 0, report
+            assert report["blocks"] >= 1, report
+            assert report["rpc_latency_ms_p50"] > 0, report
+            # every committed tx was one of ours (unique load-N= prefixes)
+            assert report["committed_txs"] <= report["sent"], report
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
