@@ -223,29 +223,50 @@ class ConsensusState:
                         continue
                 else:
                     kind, payload = await self._queue.get()
-                if kind == "quit":
-                    break
+                # Greedy drain: take everything already queued and process it
+                # in one tight batch — the per-message asyncio round trip
+                # (queue await + explicit yield) was ~30-50 us/vote under a
+                # vote storm, comparable to the actual bookkeeping. Message
+                # ORDER is exactly the queue order, and each message is still
+                # WAL-written before it is handled, so crash-recovery
+                # semantics are unchanged. Bounded so a firehose peer cannot
+                # starve timers/RPC for more than one batch.
+                batch = [(kind, payload)]
+                while len(batch) < 512:
+                    try:
+                        batch.append(self._queue.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                quit_seen = False
                 try:
-                    if kind == "peer":
-                        self.wal.write(payload)
-                        self._handle_msg(payload)
-                    elif kind == "internal":
-                        self.wal.write_sync(payload)  # fsync self msgs
-                        if isinstance(payload.msg, VoteMessage):
-                            fail.fail_point("internal_vote_after_wal")
-                        self._handle_msg(payload)
-                    elif kind == "timeout":
-                        self.wal.write(payload)
-                        self._handle_timeout(payload)
-                    elif kind == "txs_available":
-                        self._handle_txs_available()
+                    for kind, payload in batch:
+                        if kind == "quit":
+                            quit_seen = True
+                            break
+                        if kind == "peer":
+                            self.wal.write(payload)
+                            self._handle_msg(payload)
+                        elif kind == "internal":
+                            self.wal.write_sync(payload)  # fsync self msgs
+                            if isinstance(payload.msg, VoteMessage):
+                                fail.fail_point("internal_vote_after_wal")
+                            self._handle_msg(payload)
+                        elif kind == "timeout":
+                            self.wal.write(payload)
+                            self._handle_timeout(payload)
+                        elif kind == "txs_available":
+                            self._handle_txs_available()
                     # Batch boundary: once the queue drains, flush deferred
                     # votes in one device batch (storms accumulate while the
-                    # queue is busy, then verify together).
-                    if defer and self._queue.empty():
+                    # queue is busy, then verify together). Never on quit —
+                    # a shutdown must not batch-verify, commit, or publish
+                    # into components that are already stopping.
+                    if defer and not quit_seen and self._queue.empty():
                         self._flush_deferred_votes()
                 except Exception:
                     logger.exception("CONSENSUS FAILURE!!! halting (halt-don't-corrupt)")
+                    break
+                if quit_seen:
                     break
         finally:
             self._stopped.set()
