@@ -131,6 +131,7 @@ def make_mixed_batch(n, n_sr, seed=0, msg_len=40):
     return pubkeys, msgs, sigs, types
 
 
+@pytest.mark.heavy
 def test_rlc_mixed_all_valid_device_path(rlc_on):
     pubkeys, msgs, sigs, types = make_mixed_batch(40, 10)
     mask = B.verify_batch(pubkeys, msgs, sigs, backend="jax", key_types=types)
@@ -142,6 +143,7 @@ def test_rlc_mixed_all_valid_device_path(rlc_on):
         assert B._cache_key(bytes(pk), t) in B._A_CACHE
 
 
+@pytest.mark.heavy
 def test_rlc_mixed_bad_rows_fall_back_to_exact_mask(rlc_on):
     pubkeys, msgs, sigs, types = make_mixed_batch(40, 10, seed=3)
     sr_rows = [i for i, t in enumerate(types) if t == "sr25519"]

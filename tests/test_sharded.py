@@ -55,6 +55,7 @@ def make_inputs(n):
 # one mesh layout only: each layout compiles the kernel afresh on 8 virtual
 # devices (~2 min); the 2D blocks x vals layout is exercised every round by
 # __graft_entry__.dryrun_multichip
+@pytest.mark.heavy
 @pytest.mark.parametrize(
     "mesh_shape,axes,batch_shape",
     [((8,), ("vals",), (32,))],
@@ -80,6 +81,7 @@ def test_sharded_verify_matches_single_device(mesh_shape, axes, batch_shape):
     assert mask.sum() == n - 2
 
 
+@pytest.mark.heavy
 def test_verify_batch_routes_through_mesh(monkeypatch):
     """Production routing: with >1 device and TMTPU_SHARDED=1, verify_batch
     must execute the sharded kernel (crypto/batch._sharded_runner), making
@@ -99,6 +101,7 @@ def test_verify_batch_routes_through_mesh(monkeypatch):
     B._SHARDED_RUNNER = None
 
 
+@pytest.mark.heavy
 def test_sharded_rlc_check_all_valid_and_fallback(monkeypatch):
     """The RLC/Pippenger fast path sharded over the mesh (r3 verdict item 5):
     all-valid batches pass the combined check with lanes split across 8
