@@ -652,7 +652,9 @@ def _rlc_submit(
         pts_a = np.tile(b_enc, (na, 1))
         if precheck.any():
             pts_a[:n][precheck] = a_rows[precheck]
-        dev = msm_jax.rlc_check_submit(np.concatenate([pts_a, pts_r], axis=0), scalars)
+        dev = msm_jax.rlc_check_submit(
+            np.concatenate([pts_a, pts_r], axis=0), scalars, zero16_from=na
+        )
     return _RlcCall(
         precheck, n, na, "cached" if cached else "plain", dev,
         a_rows if not cached else None, _time.perf_counter() - t0,

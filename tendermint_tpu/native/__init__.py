@@ -88,7 +88,7 @@ def _build() -> "ctypes.CDLL | None":
     i32p = ctypes.POINTER(ctypes.c_int32)
     lib.tm_ed25519_h_batch.argtypes = [u8p, u8p, u8p, i64p, ctypes.c_int64, u8p, ctypes.c_int]
     lib.tm_rlc_scalars.argtypes = [u8p, u8p, u8p, ctypes.c_int64, u8p, u8p, ctypes.c_int]
-    lib.tm_sort_windows.argtypes = [u8p, ctypes.c_int64, i32p, i32p, ctypes.c_int]
+    lib.tm_sort_windows.argtypes = [u8p, ctypes.c_int64, i32p, i32p, ctypes.c_int, ctypes.c_int64]
     lib.tm_sr25519_verify_one.argtypes = [u8p, u8p, ctypes.c_int64, u8p]
     lib.tm_sr25519_verify_one.restype = ctypes.c_int
     lib.tm_sr25519_verify_batch.argtypes = [u8p, u8p, i64p, u8p, ctypes.c_int64, u8p, ctypes.c_int]
@@ -196,10 +196,12 @@ def sr25519_verify_batch(
     return out.astype(bool)
 
 
-def sort_windows(digits: np.ndarray):
+def sort_windows(digits: np.ndarray, zero16_from: int = 0):
     """Per-window counting sort: digits (n, 32) uint8 row-major ->
     (perm (32, n) int32 stable, ends (32, 256) int32). Same contract as
-    ops/msm_jax.sort_windows (which downcasts perm for the wire)."""
+    ops/msm_jax.sort_windows (which downcasts perm for the wire).
+    zero16_from > 0 promises rows >= it are zero in windows 16-31 (the
+    RLC z-lane is 128-bit), skipping their count pass."""
     lib = _lib()
     assert lib is not None
     n = digits.shape[0]
@@ -210,5 +212,6 @@ def sort_windows(digits: np.ndarray):
     lib.tm_sort_windows(
         _u8p(digits), n,
         perm.ctypes.data_as(i32p), ends.ctypes.data_as(i32p), _NTHREADS,
+        int(zero16_from),
     )
     return perm, ends

@@ -379,16 +379,27 @@ typedef struct {
   int32_t *perm;  /* 32 x n, window-major */
   int32_t *ends;  /* 32 x 256 */
   int w_lo, w_hi;
+  int64_t zero16_from; /* rows >= this have digit 0 in windows 16-31
+                          (RLC layout: the z-lane scalars are 128-bit);
+                          0 disables the shortcut */
 } sort_job;
 
 static void *sort_worker(void *arg) {
   sort_job *j = (sort_job *)arg;
   int64_t n = j->n;
   for (int w = j->w_lo; w < j->w_hi; w++) {
+    /* rows >= zlim are known-zero for this window: skip their count pass
+     * and digit lookups; in the stable order they form the TAIL of bucket
+     * 0 (prefix zero-digit rows come first — lower row index), so they
+     * are appended sequentially after the prefix placement. */
+    int64_t zlim =
+        (j->zero16_from > 0 && w >= 16 && j->zero16_from < n) ? j->zero16_from
+                                                              : n;
     int32_t counts[256];
     memset(counts, 0, sizeof(counts));
     const uint8_t *col = j->digits + w;
-    for (int64_t i = 0; i < n; i++) counts[col[i * 32]]++;
+    for (int64_t i = 0; i < zlim; i++) counts[col[i * 32]]++;
+    counts[0] += (int32_t)(n - zlim);
     int32_t start[256];
     int32_t acc = 0;
     for (int v = 0; v < 256; v++) {
@@ -397,15 +408,20 @@ static void *sort_worker(void *arg) {
       j->ends[w * 256 + v] = acc;
     }
     int32_t *p = j->perm + (int64_t)w * n;
-    for (int64_t i = 0; i < n; i++) p[start[col[i * 32]]++] = (int32_t)i;
+    /* bucket 0's suffix region: reserve it BEHIND the prefix zeros */
+    int64_t n_suffix = n - zlim;
+    int32_t suffix_at = start[0] + (int32_t)(counts[0] - (int32_t)n_suffix);
+    for (int64_t i = 0; i < zlim; i++) p[start[col[i * 32]]++] = (int32_t)i;
+    for (int64_t i = zlim; i < n; i++) p[suffix_at++] = (int32_t)i;
   }
   return 0;
 }
 
 /* digits: (n, 32) uint8 row-major -> perm (32, n) int32 (stable order),
- * ends (32, 256) int32 inclusive bucket boundaries. */
+ * ends (32, 256) int32 inclusive bucket boundaries. zero16_from > 0
+ * promises rows >= it are zero in windows 16-31 (RLC z-lane layout). */
 void tm_sort_windows(const uint8_t *digits, int64_t n, int32_t *perm,
-                     int32_t *ends, int nthreads) {
+                     int32_t *ends, int nthreads, int64_t zero16_from) {
   if (nthreads < 1) nthreads = 1;
   if (nthreads > 32) nthreads = 32;
   pthread_t tids[32];
@@ -416,7 +432,7 @@ void tm_sort_windows(const uint8_t *digits, int64_t n, int32_t *perm,
     int lo = t * per, hi = lo + per;
     if (lo >= 32) break;
     if (hi > 32) hi = 32;
-    jobs[t] = (sort_job){digits, n, perm, ends, lo, hi};
+    jobs[t] = (sort_job){digits, n, perm, ends, lo, hi, zero16_from};
     used = t + 1;
     if (hi == 32) break;
   }

@@ -253,7 +253,7 @@ def fenwick_node_indices(ends: np.ndarray, n_lanes: int) -> np.ndarray:
     return out
 
 
-def sort_windows(digits: np.ndarray):
+def sort_windows(digits: np.ndarray, zero16_from: int = 0):
     """digits: (n_lanes, T) uint8 — window w digit of lane i is byte w of
     its scalar. Returns (perm (T, N), ends (T, NBUCKETS) int32).
 
@@ -272,7 +272,7 @@ def sort_windows(digits: np.ndarray):
         from tendermint_tpu import native
 
         if native.available():
-            perm32, ends = native.sort_windows(digits)
+            perm32, ends = native.sort_windows(digits, zero16_from)
             return np.ascontiguousarray(perm32.astype(idt)), ends
     # per-column stable argsort in ONE call (axis=0), then counts via a
     # single bincount over offset digits
@@ -749,14 +749,18 @@ def decompress_rows(rows: np.ndarray) -> Tuple[Tuple[np.ndarray, ...], np.ndarra
     return coords, np.asarray(ok)[:m]
 
 
-def rlc_check_submit(pts_bytes: np.ndarray, scalars: Sequence[int]):
+def rlc_check_submit(
+    pts_bytes: np.ndarray, scalars: Sequence[int], zero16_from: int = 0
+):
     """Host prep + async device submit: pts_bytes (N, 32) uint8 encodings,
     [A block | R block] with scalars to match (0 = excluded lane; R-block
-    scalars < 2^128). Returns an unsynced device bool (1+N,):
+    scalars < 2^128). zero16_from: the A/R boundary when known (R-block
+    scalars being < 2^128 lets the sort skip those rows in the high
+    windows). Returns an unsynced device bool (1+N,):
     [batch_ok, lane_ok...] — np.asarray() it to sync."""
     n = pts_bytes.shape[0]
     digits = scalars_to_bytes(scalars, n)
-    perm, ends = sort_windows(digits)
+    perm, ends = sort_windows(digits, zero16_from=zero16_from)
     fctx = make_ctx((n,))
     return aot_cache.call(
         "rlc_plain", _rlc_jit,
@@ -792,7 +796,9 @@ def rlc_check_cached_submit(
             fctx,
             make_small_ctx(),
         )
-    perm, ends = sort_windows(digits)
+    # rows >= na are the z-lane (128-bit scalars) + padding: zero digits in
+    # windows 16-31, so the sort skips their count pass
+    perm, ends = sort_windows(digits, zero16_from=na)
     return aot_cache.call(
         "rlc_cached", _rlc_cached_jit,
         *a_coords,
@@ -826,7 +832,8 @@ def rlc_check_cached_mixed_submit(
     ns = sr_r_bytes.shape[0]
     n = na + ne + ns
     digits = scalars_to_bytes(scalars, n)
-    perm, ends = sort_windows(digits)
+    # rows >= na are the (128-bit) z-lane scalars of both R blocks
+    perm, ends = sort_windows(digits, zero16_from=na)
     return aot_cache.call(
         "rlc_mixed", _rlc_cached_mixed_jit,
         *a_coords,

@@ -95,6 +95,22 @@ def test_sort_windows_matches_numpy():
         assert (perm_c == perm_py).all()
 
 
+def test_sort_windows_zero16_shortcut_matches_full_sort():
+    """zero16_from (rows >= boundary are zero in windows 16-31 — the RLC
+    z-lane layout) must produce the exact stable-sort result."""
+    native = _native()
+    rng = np.random.default_rng(12)
+    for n, na in ((8, 4), (513, 256), (2048, 1024)):
+        digits = rng.integers(0, 256, size=(n, 32), dtype=np.uint8)
+        digits[na:, 16:] = 0  # the layout invariant the shortcut relies on
+        # some prefix rows zero too (w-lane rows can be excluded => 0)
+        digits[1, :] = 0
+        perm_full, ends_full = native.sort_windows(digits)
+        perm_z, ends_z = native.sort_windows(digits, zero16_from=na)
+        assert (ends_z == ends_full).all(), (n, na)
+        assert (perm_z == perm_full).all(), (n, na)
+
+
 def test_precheck_and_hash_fast_matches_python():
     from tendermint_tpu.crypto import batch as B
 
