@@ -52,3 +52,18 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 from tendermint_tpu.ops import cache_hardening  # noqa: E402
 
 cache_hardening.harden()
+
+
+def free_compile_memory() -> None:
+    """Drop every previously-compiled executable in this process. Used as a
+    module fixture by the heavyweight kernel test modules: XLA ABORTED
+    (SIGABRT in backend_compile r4, in the persistent-cache read path r5)
+    compiling/deserializing their multi-hundred-MB executables in a process
+    already holding many earlier tests' executables. Later tests reload
+    from the persistent cache."""
+    import gc
+
+    import jax as _jax
+
+    _jax.clear_caches()
+    gc.collect()

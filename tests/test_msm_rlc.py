@@ -38,6 +38,20 @@ def make_batch(n, seed=0, msg_len=40):
     return pubkeys, msgs, sigs
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _free_compile_memory():
+    """Same guard as tests/test_sharded.py: XLA aborted (SIGABRT inside
+    compilation_cache.get_executable_and_time) deserializing this module's
+    large RLC executables in a process already holding ~36 earlier kernel
+    tests' executables (observed r5 full-lane run; passes standalone).
+    Dropping accumulated executables first keeps the process under the
+    ceiling — later tests reload from the persistent cache."""
+    from tests.conftest import free_compile_memory
+
+    free_compile_memory()
+    yield
+
+
 @pytest.fixture
 def rlc_on(monkeypatch):
     monkeypatch.setattr(B, "RLC_MIN", 1)

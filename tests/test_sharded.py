@@ -29,12 +29,9 @@ def _free_compile_memory():
     executables it ABORTED inside backend_compile (observed r4). Dropping
     every previously-compiled executable first keeps the full-suite process
     under the ceiling (later modules reload from the persistent cache)."""
-    import gc
+    from tests.conftest import free_compile_memory
 
-    import jax as _jax
-
-    _jax.clear_caches()
-    gc.collect()
+    free_compile_memory()
     yield
 
 
