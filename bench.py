@@ -617,29 +617,67 @@ def bench_mixed_streaming(n: int = 10000, sr_frac: float = 0.2):
     }
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def watchdog(seconds: float):
+    """Abort a stage if it stalls: the device tunnel has been observed to
+    hang INDEFINITELY (even a tiny jit never returns) — without a watchdog
+    one stalled config would hang the whole bench past the driver's
+    timeout and lose every completed result. SIGALRM interrupts the
+    blocking socket waits inside jax's tunnel client; the per-config
+    try/except in main() turns the raise into a logged FAILURE and the
+    final JSON still prints."""
+    import signal
+
+    def _fire(signum, frame):
+        raise TimeoutError(f"bench stage exceeded {seconds:.0f}s watchdog")
+
+    prev = signal.signal(signal.SIGALRM, _fire)
+    signal.alarm(max(1, int(seconds)))
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)
+
+
 def main():
     """Time-budgeted: each config runs only if enough budget remains (first
     compiles are minutes); the final JSON ALWAYS prints, with the largest
     completed config as the headline. Budget via TMTPU_BENCH_BUDGET_S."""
     import jax
 
-    # The env vars at the top are ignored when an injected sitecustomize has
-    # already imported jax at interpreter start; config.update works
-    # post-import.
-    cache_dir = os.environ["JAX_COMPILATION_CACHE_DIR"]
-    if jax.default_backend() == "cpu":
-        # never mix CPU entries into the TPU cache dir (corrupted entries
-        # crashed the cache read path; see tests/conftest.py)
-        cache_dir = os.path.join(cache_dir, "cpu")
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    # Atomic cache writes — a killed bench must not poison the shared cache
-    # (see ops/cache_hardening.py).
-    from tendermint_tpu.ops import cache_hardening
+    # Device INITIALIZATION can hang indefinitely when the tunnel is down
+    # (observed: jax.devices() never returns) — that happens before any
+    # config's own watchdog, so guard it explicitly and emit the fallback
+    # JSON instead of hanging into the driver's timeout.
+    try:
+        with watchdog(180.0):
+            # The env vars at the top are ignored when an injected
+            # sitecustomize has already imported jax at interpreter start;
+            # config.update works post-import.
+            cache_dir = os.environ["JAX_COMPILATION_CACHE_DIR"]
+            if jax.default_backend() == "cpu":
+                # never mix CPU entries into the TPU cache dir (corrupted
+                # entries crashed the cache read path; see tests/conftest.py)
+                cache_dir = os.path.join(cache_dir, "cpu")
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            # Atomic cache writes — a killed bench must not poison the
+            # shared cache (see ops/cache_hardening.py).
+            from tendermint_tpu.ops import cache_hardening
 
-    cache_hardening.harden()
-
-    log("devices:", jax.devices())
+            cache_hardening.harden()
+            log("devices:", jax.devices())
+    except TimeoutError as e:
+        # only fires for interruptible init stalls; the HARD jax.devices()
+        # hang doesn't service SIGALRM and is covered by guarded_main's
+        # process-group deadline instead
+        log(f"[init] device initialization stalled: {e}")
+        _emit_fallback("device initialization stalled (tunnel down?)")
+        return
     budget = float(os.environ.get("TMTPU_BENCH_BUDGET_S", "1500"))
     t_start = time.perf_counter()
 
@@ -665,7 +703,16 @@ def main():
         res = None
         for attempt in range(2):
             try:
-                res = bench_config(name, n, serial_n=serial_n, rlc=n >= RLC_MIN)
+                # leave ~2 min of budget for the remaining stages + the
+                # final JSON even if this config stalls (tunnel hangs are
+                # indefinite — see watchdog)
+                with watchdog(max(180.0, remaining() - 120.0)):
+                    res = bench_config(name, n, serial_n=serial_n, rlc=n >= RLC_MIN)
+                break
+            except TimeoutError as e:
+                # a watchdog stall is NOT transient — retrying a dead tunnel
+                # just burns the budget reserved for the other stages
+                log(f"[{name}] STALLED, not retrying: {e}")
                 break
             except Exception as e:  # transient tunnel/compile errors: retry once
                 log(f"[{name}] attempt {attempt + 1} FAILED: {e}")
@@ -677,7 +724,8 @@ def main():
     if head is not None and remaining() > 120:
         try:
             sn = head[1]["n"]
-            stream = bench_streaming(sn)
+            with watchdog(max(120.0, remaining() - 60.0)):
+                stream = bench_streaming(sn)
             extra[f"streaming_{sn}_sigs_per_sec"] = round(stream)
             log(f"[streaming] {stream:,.0f} sigs/s sustained (pipelined RLC)")
         except Exception as e:
@@ -685,7 +733,8 @@ def main():
 
     if head is not None and remaining() > 240:
         try:
-            fr = bench_fastsync_replay()
+            with watchdog(max(180.0, remaining() - 60.0)):
+                fr = bench_fastsync_replay()
             extra["fastsync_replay"] = fr
             log(f"[fastsync_replay] {fr['tpu_blocks_per_sec']:.1f} blocks/s ({fr['speedup']}x)")
         except Exception as e:
@@ -693,7 +742,8 @@ def main():
 
     if head is not None and remaining() > 180:
         try:
-            mx = bench_mixed_streaming()
+            with watchdog(max(150.0, remaining() - 60.0)):
+                mx = bench_mixed_streaming()
             extra["mixed_streaming"] = mx
             log(f"[mixed_streaming] {mx['sigs_per_sec']:,} sigs/s ({mx['speedup']}x)")
         except Exception as e:
@@ -701,7 +751,8 @@ def main():
 
     if head is not None and remaining() > 120:
         try:
-            vsr = bench_vote_storm()
+            with watchdog(max(120.0, remaining() - 60.0)):
+                vsr = bench_vote_storm()
             extra["vote_storm_deferred"] = vsr
             log(
                 f"[vote_storm] serial {vsr['votes_per_sec_serial']:,}/s vs "
@@ -712,7 +763,8 @@ def main():
 
     if head is not None and remaining() > 240:
         try:
-            lc = bench_live_consensus()
+            with watchdog(max(200.0, remaining() - 40.0)):
+                lc = bench_live_consensus()
             extra["live_consensus"] = lc
             log(
                 f"[live_consensus] blocks/s serial {lc['serial_blocks_per_sec']} vs "
@@ -739,5 +791,69 @@ def main():
     )
 
 
+def _emit_fallback(err: str) -> None:
+    print(json.dumps({"metric": "verify_commit_latency", "value": -1,
+                      "unit": "ms", "vs_baseline": 0, "extra": {"error": err}}))
+
+
+def _salvage_json(out: str) -> bool:
+    """Forward the LAST parseable JSON line from child output, if any — a
+    child can print its complete result and THEN crash or hang in teardown
+    (the tunnel client's threads); that result must not be lost."""
+    for line in reversed(out.strip().splitlines()):
+        try:
+            json.loads(line)
+        except ValueError:
+            continue
+        print(line)
+        return True
+    return False
+
+
+def guarded_main():
+    """Run main() in a CHILD process under a hard deadline, so stdout gets
+    exactly one JSON line even when the device tunnel hangs in a way no
+    in-process watchdog can interrupt (observed: jax.devices() blocks in C
+    without servicing SIGALRM). The per-stage watchdogs inside main() still
+    salvage partial results from soft stalls; this parent guard covers the
+    hard ones. The child runs in its own process GROUP and the whole group
+    is killed on timeout — jax helper processes inherit the stdout pipe,
+    and killing only the direct child would leave the parent blocked on
+    pipe EOF forever."""
+    import signal as _signal
+    import subprocess
+
+    if os.environ.get("TMTPU_BENCH_CHILD") == "1":
+        main()
+        return
+    budget = float(os.environ.get("TMTPU_BENCH_BUDGET_S", "1500"))
+    margin = float(os.environ.get("TMTPU_BENCH_HARD_MARGIN_S", "180"))
+    env = dict(os.environ, TMTPU_BENCH_CHILD="1")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        stdout=subprocess.PIPE,
+        start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=budget + margin)
+        out = out.decode()
+        if _salvage_json(out):
+            return  # child's result forwarded, even if its rc != 0
+        _emit_fallback(f"bench child exited rc={proc.returncode} with no JSON")
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, _signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            out, _ = proc.communicate(timeout=30.0)
+            if _salvage_json(out.decode()):
+                return  # result printed before the hang: keep it
+        except Exception:
+            pass
+        _emit_fallback("bench child exceeded hard deadline (device tunnel hung?)")
+
+
 if __name__ == "__main__":
-    main()
+    guarded_main()

@@ -1,0 +1,110 @@
+"""bench.py's stall guards: the driver's end-of-round bench must emit its
+one JSON line even when the device tunnel hangs uninterruptibly (observed
+r5: jax.devices() blocked in C without servicing SIGALRM, indefinitely)."""
+
+import contextlib
+import io
+import json
+import os
+import sys
+import time
+
+import pytest
+
+
+def _bench():
+    import bench
+
+    return bench
+
+
+def test_watchdog_fires_and_resets():
+    bench = _bench()
+    with pytest.raises(TimeoutError):
+        with bench.watchdog(1):
+            time.sleep(3)
+    # alarm cleared: nothing fires after the context exits
+    with bench.watchdog(1):
+        pass
+    time.sleep(1.2)
+
+
+def test_guarded_main_passes_child_json_through(tmp_path, monkeypatch):
+    bench = _bench()
+    stub = tmp_path / "stub_bench.py"
+    stub.write_text('print(\'{"metric": "stub", "value": 1, "unit": "ms", "vs_baseline": 2.0}\')\n')
+    monkeypatch.setattr(bench, "__file__", str(stub))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.guarded_main()
+    out = buf.getvalue()
+    assert json.loads(out)["metric"] == "stub"
+    assert out.count("\n") == 1
+
+
+def test_guarded_main_emits_fallback_on_hung_child(tmp_path, monkeypatch):
+    bench = _bench()
+    stub = tmp_path / "hang_bench.py"
+    stub.write_text("import time\ntime.sleep(600)\n")
+    monkeypatch.setattr(bench, "__file__", str(stub))
+    monkeypatch.setenv("TMTPU_BENCH_BUDGET_S", "1")
+    monkeypatch.setenv("TMTPU_BENCH_HARD_MARGIN_S", "1")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.guarded_main()
+    rep = json.loads(buf.getvalue())
+    assert rep["value"] == -1
+    assert "deadline" in rep["extra"]["error"]
+
+
+def test_guarded_main_salvages_json_printed_before_hang(tmp_path, monkeypatch):
+    """A child that prints its complete result and THEN hangs in teardown
+    (the tunnel client's threads) must have that result forwarded."""
+    bench = _bench()
+    stub = tmp_path / "hang_after_json.py"
+    stub.write_text(
+        'import sys, time\n'
+        'print(\'{"metric": "late", "value": 7, "unit": "ms", "vs_baseline": 3.0}\', flush=True)\n'
+        "time.sleep(600)\n"
+    )
+    monkeypatch.setattr(bench, "__file__", str(stub))
+    # deadline must comfortably cover interpreter startup under load: the
+    # stub prints immediately, so 8 s total is plenty and stays flake-free
+    monkeypatch.setenv("TMTPU_BENCH_BUDGET_S", "4")
+    monkeypatch.setenv("TMTPU_BENCH_HARD_MARGIN_S", "4")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.guarded_main()
+    rep = json.loads(buf.getvalue())
+    assert rep["metric"] == "late" and rep["value"] == 7
+
+
+def test_guarded_main_salvages_json_from_crashing_child(tmp_path, monkeypatch):
+    """A child that prints the result then exits NONZERO (teardown crash)
+    must still have the result forwarded, not replaced by the fallback."""
+    bench = _bench()
+    stub = tmp_path / "crash_after_json.py"
+    stub.write_text(
+        'import sys\n'
+        'print(\'{"metric": "crashy", "value": 9, "unit": "ms", "vs_baseline": 1.5}\')\n'
+        "sys.exit(134)\n"
+    )
+    monkeypatch.setattr(bench, "__file__", str(stub))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.guarded_main()
+    rep = json.loads(buf.getvalue())
+    assert rep["metric"] == "crashy" and rep["value"] == 9
+
+
+def test_guarded_main_emits_fallback_on_dead_child(tmp_path, monkeypatch):
+    bench = _bench()
+    stub = tmp_path / "dead_bench.py"
+    stub.write_text("import sys\nsys.exit(3)\n")
+    monkeypatch.setattr(bench, "__file__", str(stub))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.guarded_main()
+    rep = json.loads(buf.getvalue())
+    assert rep["value"] == -1
+    assert "rc=3" in rep["extra"]["error"]
