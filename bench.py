@@ -28,6 +28,20 @@ Sub-benchmarks (in "extra", budget permitting):
   mixed_streaming     — ed25519+sr25519 mixed 10k set (config 5)
   streaming_{n}_sigs_per_sec — sustained sigs/s, pipelined RLC batches
 
+Flight-recorder breakdown (always in "extra", including the stall fallback):
+  verify_stats  — per-stage pipeline telemetry from libs/trace.py:
+                  "totals" (flushes/sigs/seconds per backend+path),
+                  "stage_seconds" (prep = host hashing/scalar math,
+                  compile = kernel trace/export/load, transfer = blocked in
+                  device sync, total = end-to-end), "counters" (RLC
+                  fallbacks, pubkey-cache hits/misses) and "last_flush"
+                  (batch size, jit bucket + padding waste, chosen path).
+                  Stage-to-pipeline mapping: docs/OBSERVABILITY.md.
+  device_health — device_up (1/0/None), init_seconds,
+                  last_call_age_s, last_error — so a
+                  "verify_commit_latency = -1" run names the stalled stage
+                  instead of reporting one opaque number.
+
 Run WITHOUT the test conftest (needs the real TPU): `python bench.py`.
 """
 
@@ -131,14 +145,16 @@ def time_rlc(pubkeys, msgs, sigs, iters: int = 3):
 
     B._fill_a_cache(np.stack([np.frombuffer(pk, dtype=np.uint8) for pk in pubkeys]))
     t0 = time.perf_counter()
-    mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+    # explicit backend="jax" rides the instrumented verify_batch wrapper, so
+    # each timed call also lands in the flight recorder's verify_stats
+    mask = B.verify_batch(pubkeys, msgs, sigs, backend="jax")
     first = time.perf_counter() - t0
     assert mask.all()
     best = float("inf")
     prep = None
     for _ in range(iters):
         t0 = time.perf_counter()
-        mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+        mask = B.verify_batch(pubkeys, msgs, sigs, backend="jax")
         dt = time.perf_counter() - t0
         assert mask.all()
         if dt < best:
@@ -653,6 +669,9 @@ def main():
     # (observed: jax.devices() never returns) — that happens before any
     # config's own watchdog, so guard it explicitly and emit the fallback
     # JSON instead of hanging into the driver's timeout.
+    from tendermint_tpu.libs import trace as _trace
+
+    t_init = time.perf_counter()
     try:
         with watchdog(180.0):
             # The env vars at the top are ignored when an injected
@@ -671,10 +690,16 @@ def main():
 
             cache_hardening.harden()
             log("devices:", jax.devices())
+            # device_up flips to 1 here; the stall path below records 0 —
+            # the flight-recorder gauge the stall detector reports
+            _trace.record_device_init(time.perf_counter() - t_init, ok=True)
     except TimeoutError as e:
         # only fires for interruptible init stalls; the HARD jax.devices()
         # hang doesn't service SIGALRM and is covered by guarded_main's
         # process-group deadline instead
+        _trace.record_device_init(
+            time.perf_counter() - t_init, ok=False, error=str(e)
+        )
         log(f"[init] device initialization stalled: {e}")
         _emit_fallback("device initialization stalled (tunnel down?)")
         return
@@ -774,10 +799,10 @@ def main():
             log(f"[live_consensus] FAILED: {e}")
 
     if head is None:
-        print(json.dumps({"metric": "verify_commit_latency", "value": -1,
-                          "unit": "ms", "vs_baseline": 0, "extra": {"error": "no config completed"}}))
+        _emit_fallback("no config completed")
         return
     name, res = head
+    extra.update(_flight_recorder_extra())
     print(
         json.dumps(
             {
@@ -791,9 +816,26 @@ def main():
     )
 
 
+def _flight_recorder_extra() -> dict:
+    """The per-stage breakdown attached to every result's `extra` (see the
+    module docstring / --help): future BENCH_r*.json files localise a
+    regression to prep vs compile vs transfer vs path choice instead of
+    reporting one opaque latency."""
+    try:
+        from tendermint_tpu.libs import trace as _trace
+
+        stats = _trace.verify_stats()
+        device = stats.pop("device", None)
+        return {"verify_stats": stats, "device_health": device}
+    except Exception as e:  # never lose the bench result to telemetry
+        return {"verify_stats": {"error": repr(e)}}
+
+
 def _emit_fallback(err: str) -> None:
+    extra = {"error": err}
+    extra.update(_flight_recorder_extra())
     print(json.dumps({"metric": "verify_commit_latency", "value": -1,
-                      "unit": "ms", "vs_baseline": 0, "extra": {"error": err}}))
+                      "unit": "ms", "vs_baseline": 0, "extra": extra}))
 
 
 def _salvage_json(out: str) -> bool:
@@ -856,4 +898,14 @@ def guarded_main():
 
 
 if __name__ == "__main__":
+    import argparse
+
+    # --help carries the full module docstring, including the per-stage
+    # `extra.verify_stats` / `extra.device_health` breakdown contract.
+    # parse_known_args: unknown argv must not exit(2) before the one-JSON-
+    # line contract (guarded_main/_emit_fallback) can be honored.
+    argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    ).parse_known_args()
     guarded_main()

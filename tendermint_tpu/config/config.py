@@ -155,6 +155,14 @@ class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
     namespace: str = "tendermint_tpu"
+    # Flight recorder for the batch-verify pipeline (libs/trace.py; no
+    # reference counterpart). trace_enabled=false reduces the batch path's
+    # tracing work to a single flag check; the ring holds the most recent
+    # trace_ring_size span/event records, served by the /debug/trace RPC
+    # route. Process-global (like the verify mode): the last Node
+    # constructed in a process wins.
+    trace_enabled: bool = True
+    trace_ring_size: int = 4096
 
 
 @dataclass

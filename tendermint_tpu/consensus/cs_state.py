@@ -39,6 +39,7 @@ from tendermint_tpu.consensus.wal import (
     TimeoutInfo,
 )
 from tendermint_tpu.libs import fail
+from tendermint_tpu.libs.trace import tracer as _tracer
 from tendermint_tpu.state.execution import BlockExecutor, BlockValidationError
 from tendermint_tpu.state.sm_state import State
 from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
@@ -825,6 +826,13 @@ class ConsensusState:
         _tv0 = time.perf_counter()
         self.block_exec.validate_block(self.state, block)
         _tv1 = time.perf_counter()
+        if _tracer.enabled:
+            _tracer.event(
+                "consensus.commit_verify",
+                height=height,
+                n_sigs=len(block.last_commit.signatures),
+                dur_ms=round((_tv1 - _tv0) * 1e3, 3),
+            )
 
         logger.info("finalizing commit of block %d txs=%d hash=%s",
                     block.header.height, len(block.txs), block.hash().hex()[:12])
@@ -916,11 +924,30 @@ class ConsensusState:
         types/vote_set.go:143,203)."""
         rs = self.rs
         if rs.votes is not None and rs.votes.has_pending():
-            height_before = rs.height
-            votes_before = rs.votes
-            flushed = votes_before.flush_all()
-            for err in votes_before.drain_conflicts():
-                self._handle_vote_conflict(err)
+            tr = _tracer if _tracer.enabled else None
+            span = None
+            if tr is not None:
+                span = tr.span("consensus.vote_flush", height=rs.height)
+                span.__enter__()
+            try:
+                height_before = rs.height
+                votes_before = rs.votes
+                flushed = votes_before.flush_all()
+                for err in votes_before.drain_conflicts():
+                    self._handle_vote_conflict(err)
+                if span is not None:
+                    span.set(
+                        committed=sum(len(c) for _, _, c, _ in flushed),
+                        failed=sum(len(f) for _, _, _, f in flushed),
+                    )
+            finally:
+                # always close: a raise between enter and here would corrupt
+                # the tracer's thread-local span stack for the whole loop —
+                # and pass the live exception so the span records error=...
+                if span is not None:
+                    import sys as _sys
+
+                    span.__exit__(*_sys.exc_info())
             for vtype, vround, committed, failed in flushed:
                 # Publish only now: enqueue time would advertise (HasVote)
                 # signatures we have not verified, letting a forged vote

@@ -31,11 +31,13 @@ from __future__ import annotations
 
 import hashlib
 import os
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from tendermint_tpu.crypto.ed25519_ref import L
+from tendermint_tpu.libs import trace as _trace
 
 L8 = 8 * L  # full curve-group order; scalar modulus for torsion-exact RLC
 
@@ -143,6 +145,8 @@ def prepare_batch(
     """
     n = len(pubkeys)
     b = _bucket(max(n, 1))
+    LAST_FLUSH_DETAIL["jit_bucket"] = b
+    LAST_FLUSH_DETAIL["padding_lanes"] = b - n
     a = np.zeros((b, 32), dtype=np.uint8)
     r = np.zeros((b, 32), dtype=np.uint8)
     s = np.zeros((b, 32), dtype=np.uint8)
@@ -456,6 +460,13 @@ class _RlcCall:
 # Timing of the last completed RLC call (host-prep vs total), for bench.py.
 LAST_RLC_TIMINGS: dict = {}
 
+# Per-flush flight-recorder detail, filled by the path that actually ran
+# (prepare_batch, _rlc_submit, _rlc_finish) and consumed by verify_batch /
+# verify_batch_finish into libs.trace.record_flush. Best-effort shared state
+# (same model as LAST_RLC_TIMINGS): concurrent flushes may interleave fields,
+# which is acceptable for observability and free on the hot path.
+LAST_FLUSH_DETAIL: dict = {}
+
 
 def _sample_z(rng, n: int, precheck) -> list:
     """Random RLC coefficients: ~124-bit, nonzero, and ≡ 0 (mod 8) so every
@@ -494,12 +505,10 @@ def _rlc_submit(
     in steady state. Mixed ed25519+sr25519 batches always prefill the typed
     pubkey cache (both decoders) and run the mixed cached kernel with
     separate ed/sr R-lane blocks."""
-    import time as _time
-
     from tendermint_tpu.crypto.ed25519_ref import BASE, point_compress
     from tendermint_tpu.ops import msm_jax
 
-    t0 = _time.perf_counter()
+    t0 = time.perf_counter()
     n = len(pubkeys)
     mixed = key_types is not None and any(t == "sr25519" for t in key_types)
     from tendermint_tpu import native
@@ -517,6 +526,13 @@ def _rlc_submit(
 
     types = key_types if mixed else ["ed25519"] * n
     ckeys = [_cache_key(bytes(pubkeys[i]), types[i]) for i in range(n)]
+
+    # Pubkey-decompress cache hit rate, sampled BEFORE any fill: steady-state
+    # consensus should read ~1.0 here (same validator set every height).
+    n_pre = int(precheck.sum())
+    hits = sum(1 for i in range(n) if precheck[i] and ckeys[i] in _A_CACHE)
+    LAST_FLUSH_DETAIL["cache_hits"] = hits
+    LAST_FLUSH_DETAIL["cache_misses"] = n_pre - hits
 
     if mixed:
         # Prefill the typed cache so every included lane has coordinates.
@@ -602,6 +618,8 @@ def _rlc_submit(
         sr_pos = [i for i in range(n) if types[i] == "sr25519"]
         ne = _lane_bucket(max(len(ed_pos), 1))
         ns = _lane_bucket(max(len(sr_pos), 1))
+        LAST_FLUSH_DETAIL["jit_bucket"] = na
+        LAST_FLUSH_DETAIL["padding_lanes"] = na + ne + ns - (2 * n + 1)
         ed_r = np.tile(b_enc, (ne, 1))
         sr_r = np.zeros((ns, 32), dtype=np.uint8)  # identity: valid ristretto
         for j, i in enumerate(ed_pos):
@@ -619,7 +637,7 @@ def _rlc_submit(
             scalars[na + ne + j] = zs[i]
         dev = msm_jax.rlc_check_cached_mixed_submit(_a_block(), ed_r, sr_r, scalars)
         return _RlcCall(
-            precheck, n, na, "mixed", dev, None, _time.perf_counter() - t0,
+            precheck, n, na, "mixed", dev, None, time.perf_counter() - t0,
             ed_pos=np.asarray(ed_pos, dtype=np.int64),
             sr_pos=np.asarray(sr_pos, dtype=np.int64),
             ne=ne, ns=ns,
@@ -627,6 +645,8 @@ def _rlc_submit(
 
     # A block: [A_0..A_{n-1}, B, pads]; excluded/pad lanes are the basepoint
     # encoding with scalar 0 (bucket 0 is never summed).
+    LAST_FLUSH_DETAIL["jit_bucket"] = na
+    LAST_FLUSH_DETAIL["padding_lanes"] = 2 * na - (2 * n + 1)
     pts_r = np.tile(b_enc, (na, 1))
     if precheck.any():
         pts_r[:n][precheck] = r_rows[precheck]
@@ -657,7 +677,7 @@ def _rlc_submit(
         )
     return _RlcCall(
         precheck, n, na, "cached" if cached else "plain", dev,
-        a_rows if not cached else None, _time.perf_counter() - t0,
+        a_rows if not cached else None, time.perf_counter() - t0,
     )
 
 
@@ -665,7 +685,15 @@ def _rlc_finish(call: _RlcCall) -> Optional[np.ndarray]:
     """Sync the device result (ONE packed D2H fetch); mask on success,
     None -> per-sig fallback."""
     precheck, n, na = call.precheck, call.n, call.na
-    out = np.asarray(call.dev)  # [batch_ok, lane_ok...]
+    t_sync = time.perf_counter()
+    try:
+        out = np.asarray(call.dev)  # [batch_ok, lane_ok...]
+    except Exception as e:
+        _trace.mark_device_call(ok=False, error=repr(e))
+        raise
+    _trace.mark_device_call(ok=True)
+    LAST_FLUSH_DETAIL["transfer_s"] = time.perf_counter() - t_sync
+    LAST_FLUSH_DETAIL["prep_s"] = call.prep_seconds
     batch_ok = bool(out[0])
     ok = out[1:]
     if call.mode == "mixed":
@@ -725,12 +753,17 @@ def _verify_batch_rlc(
     """RLC fast path. Returns the bool mask if the combined check passes,
     or None when the caller must fall back to the per-signature kernel
     (some signature failed, or an encoding was invalid)."""
-    import time as _time
-
-    t0 = _time.perf_counter()
+    tr = _trace.tracer if _trace.tracer.enabled else None
+    t0 = time.perf_counter()
     try:
-        call = _rlc_submit(pubkeys, msgs, sigs, key_types)
-        mask = _rlc_finish(call)
+        if tr is not None:
+            with tr.span("rlc.submit", n=len(pubkeys)):
+                call = _rlc_submit(pubkeys, msgs, sigs, key_types)
+            with tr.span("rlc.finish", mode=call.mode):
+                mask = _rlc_finish(call)
+        else:
+            call = _rlc_submit(pubkeys, msgs, sigs, key_types)
+            mask = _rlc_finish(call)
     except Exception:
         # Any unexpected RLC-path failure (cache churn past capacity, device
         # error) degrades to the always-correct per-signature fallback
@@ -743,7 +776,7 @@ def _verify_batch_rlc(
         return None
     LAST_RLC_TIMINGS.update(
         prep_ms=call.prep_seconds * 1e3,
-        total_ms=(_time.perf_counter() - t0) * 1e3,
+        total_ms=(time.perf_counter() - t0) * 1e3,
         cached=call.mode == "cached",
         mode=call.mode,
     )
@@ -889,13 +922,21 @@ def verify_batch_jax(
                 return mask
         # Combined check failed: at least one signature is bad (or an
         # encoding was invalid) — recover the exact per-signature mask.
+        LAST_FLUSH_DETAIL["rlc_fallback"] = True
     a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
-    if sharded is not None:
-        LAST_JAX_PATH[0] = "sharded"
-        mask = np.asarray(sharded(a, r, s_bits, h_bits))[:n]
-    else:
-        LAST_JAX_PATH[0] = "persig"
-        mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
+    t_dev = time.perf_counter()
+    try:
+        if sharded is not None:
+            LAST_JAX_PATH[0] = "sharded"
+            mask = np.asarray(sharded(a, r, s_bits, h_bits))[:n]
+        else:
+            LAST_JAX_PATH[0] = "persig"
+            mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
+    except Exception as e:
+        _trace.mark_device_call(ok=False, error=repr(e))
+        raise
+    _trace.mark_device_call(ok=True)
+    LAST_FLUSH_DETAIL["transfer_s"] = time.perf_counter() - t_dev
     return mask & precheck
 
 
@@ -955,12 +996,16 @@ class BatchHandle:
     trusting+light pair, reference light/verifier.go:32) overlap their
     device round trips instead of paying one each, serially."""
 
-    __slots__ = ("_mask", "_call", "_args")
+    __slots__ = ("_mask", "_call", "_args", "_t0")
 
-    def __init__(self, mask=None, call=None, args=None):
+    def __init__(self, mask=None, call=None, args=None, t0=None):
         self._mask = mask
         self._call = call
         self._args = args
+        # submit-side wall-clock start: the flush record's total_s must span
+        # submit THROUGH finish (docs/OBSERVABILITY.md: total = end-to-end),
+        # not just the finish-side sync
+        self._t0 = t0
 
 
 def verify_batch_submit(
@@ -988,6 +1033,7 @@ def verify_batch_submit(
         return BatchHandle(
             mask=verify_batch(pubkeys, msgs, sigs, backend, key_types)
         )
+    t0 = time.perf_counter()
     try:
         call = _rlc_submit(pubkeys, msgs, sigs, key_types if mixed else None)
     except Exception:
@@ -997,15 +1043,24 @@ def verify_batch_submit(
             "RLC submit failed; falling back to synchronous verification"
         )
         return BatchHandle(mask=verify_batch(pubkeys, msgs, sigs, backend, key_types))
-    return BatchHandle(call=call, args=(pubkeys, msgs, sigs, backend, key_types, mixed))
+    return BatchHandle(
+        call=call, args=(pubkeys, msgs, sigs, backend, key_types, mixed), t0=t0
+    )
 
 
 def verify_batch_finish(h: BatchHandle) -> np.ndarray:
     if h._mask is not None:
         return h._mask
     pubkeys, msgs, sigs, backend, key_types, mixed = h._args
+    tr = _trace.tracer if _trace.tracer.enabled else None  # single flag check
+    # total spans submit through finish (h._t0); prep happened at submit
+    t0 = h._t0 if h._t0 is not None else time.perf_counter()
     try:
-        mask = _rlc_finish(h._call)
+        if tr is not None:
+            with tr.span("rlc.finish", n=len(pubkeys), async_=True):
+                mask = _rlc_finish(h._call)
+        else:
+            mask = _rlc_finish(h._call)
     except Exception:
         import logging
 
@@ -1013,17 +1068,53 @@ def verify_batch_finish(h: BatchHandle) -> np.ndarray:
             "RLC finish failed; falling back to exact verification"
         )
         mask = None
+    detail = dict(LAST_FLUSH_DETAIL)
     if mask is not None:
         h._mask = mask
+        _trace.record_flush(
+            backend="jax",
+            path="rlc-async",
+            n=len(pubkeys),
+            total_s=time.perf_counter() - t0,
+            n_valid=int(mask.sum()),
+            prep_s=detail.get("prep_s"),
+            transfer_s=detail.get("transfer_s"),
+            jit_bucket=detail.get("jit_bucket"),
+            padding_lanes=detail.get("padding_lanes"),
+            cache_hits=detail.get("cache_hits"),
+            cache_misses=detail.get("cache_misses"),
+            tracer_=tr,
+        )
         return mask
-    # combined check failed (or errored): recover the exact per-row mask
+    # combined check failed (or errored): recover the exact per-row mask.
+    # The fallback rides verify_batch-instrumented paths (mixed-exact
+    # recursion) or records its own persig-async flush below.
     if mixed:
+        LAST_FLUSH_DETAIL["rlc_fallback"] = True
         h._mask = _verify_batch_mixed_exact(pubkeys, msgs, sigs, key_types, backend)
     else:
         from tendermint_tpu.ops.ed25519_jax import verify_prepared
 
         a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
-        h._mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n] & precheck
+        t_dev = time.perf_counter()
+        try:
+            h._mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n] & precheck
+        except Exception as e:
+            _trace.mark_device_call(ok=False, error=repr(e))
+            raise
+        _trace.mark_device_call(ok=True)
+        _trace.record_flush(
+            backend="jax",
+            path="persig-async",
+            n=len(pubkeys),
+            total_s=time.perf_counter() - t0,
+            n_valid=int(h._mask.sum()),
+            transfer_s=time.perf_counter() - t_dev,
+            jit_bucket=LAST_FLUSH_DETAIL.get("jit_bucket"),
+            padding_lanes=LAST_FLUSH_DETAIL.get("padding_lanes"),
+            rlc_fallback=True,
+            tracer_=tr,
+        )
     return h._mask
 
 
@@ -1040,14 +1131,63 @@ def verify_batch(
     ed25519. Mixed sets (BASELINE config 5) above RLC_MIN verify BOTH key
     types in one device MSM (sr lanes ristretto-decoded,
     ops/ristretto_jax.py); smaller mixed sets route ed25519 rows through the
-    selected backend and sr25519 rows through the host schnorrkel path."""
+    selected backend and sr25519 rows through the host schnorrkel path.
+
+    Every flush is flight-recorded (libs/trace.py): one span + structured
+    event naming the chosen path and batch size, plus the
+    tendermint_batch_verify_* registry series. With tracing disabled the
+    only added work is ONE flag read and the (always-on) metrics update."""
     if not (len(pubkeys) == len(msgs) == len(sigs)):
         raise ValueError("pubkeys/msgs/sigs length mismatch")
     if len(pubkeys) == 0:
         return np.zeros(0, dtype=bool)
-    if key_types is not None and any(t != "ed25519" for t in key_types):
-        from tendermint_tpu.crypto.sr25519 import sr25519_verify
+    tr = _trace.tracer if _trace.tracer.enabled else None  # single flag check
+    LAST_FLUSH_DETAIL.clear()
+    compile0 = _trace.compile_seconds_total()
+    t0 = time.perf_counter()
+    span = None
+    if tr is not None:
+        span = tr.span("verify_batch", n=len(pubkeys))
+        span.__enter__()
+    try:
+        mask, be, path = _verify_batch_routed(
+            pubkeys, msgs, sigs, backend, key_types
+        )
+    except BaseException as e:
+        if span is not None:
+            span.set(error=type(e).__name__)
+            span.__exit__(None, None, None)
+        raise
+    detail = dict(LAST_FLUSH_DETAIL)
+    compile_s = _trace.compile_seconds_total() - compile0
+    _trace.record_flush(
+        backend=be,
+        path=path,
+        n=len(pubkeys),
+        total_s=time.perf_counter() - t0,
+        n_valid=int(mask.sum()),
+        prep_s=detail.get("prep_s"),
+        compile_s=compile_s if compile_s > 0 else None,
+        transfer_s=detail.get("transfer_s"),
+        jit_bucket=detail.get("jit_bucket"),
+        padding_lanes=detail.get("padding_lanes"),
+        cache_hits=detail.get("cache_hits"),
+        cache_misses=detail.get("cache_misses"),
+        rlc_fallback=detail.get("rlc_fallback", False),
+        tracer_=tr,
+    )
+    if span is not None:
+        span.set(path=path, backend=be)
+        span.__exit__(None, None, None)
+    return mask
 
+
+def _verify_batch_routed(
+    pubkeys, msgs, sigs, backend, key_types
+) -> tuple:
+    """verify_batch's routing body; returns (mask, backend, path) so the
+    flight recorder can label the flush with what actually ran."""
+    if key_types is not None and any(t != "ed25519" for t in key_types):
         be = backend or backend_default()
         # Mixed sets above the RLC threshold verify both key types in ONE
         # device MSM (ed lanes via compressed-edwards decode, sr lanes via
@@ -1067,8 +1207,16 @@ def verify_batch(
             mask = _verify_batch_rlc(pubkeys, msgs, sigs, key_types)
             if mask is not None:
                 LAST_JAX_PATH[0] = "rlc-mixed"
-                return mask
-        return _verify_batch_mixed_exact(pubkeys, msgs, sigs, key_types, backend)
+                return mask, be, "rlc-mixed"
+            rlc_fell_back = True
+        else:
+            rlc_fell_back = False
+        mask = _verify_batch_mixed_exact(pubkeys, msgs, sigs, key_types, backend)
+        if rlc_fell_back:
+            # re-set AFTER mixed-exact: its per-type recursion through
+            # verify_batch clears LAST_FLUSH_DETAIL for its own flush record
+            LAST_FLUSH_DETAIL["rlc_fallback"] = True
+        return mask, be, "mixed"
     be = backend or backend_default()
     # Auto-selected jax falls back to the host loop for tiny batches: a
     # handful of signatures is faster on CPU than one device round-trip
@@ -1078,9 +1226,9 @@ def verify_batch(
     if backend is None and be == "jax" and len(pubkeys) < _JAX_MIN_BATCH:
         be = "cpu"
     if be == "cpu":
-        return verify_batch_cpu(pubkeys, msgs, sigs)
+        return verify_batch_cpu(pubkeys, msgs, sigs), "cpu", "cpu"
     if be == "jax":
-        return verify_batch_jax(pubkeys, msgs, sigs)
+        return verify_batch_jax(pubkeys, msgs, sigs), "jax", LAST_JAX_PATH[0]
     raise ValueError(f"unknown crypto backend {be!r}")
 
 

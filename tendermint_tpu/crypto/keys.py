@@ -12,12 +12,24 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives import serialization
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives import serialization
+
+    _HAVE_OPENSSL = True
+except ImportError:  # pragma: no cover - exercised only in minimal images
+    # Gated fallback: containers without the `cryptography` wheel ride the
+    # pure-Python RFC 8032 implementation (crypto/ed25519_ref.py) for host
+    # sign/verify — slow (~ms/op) but exact for the COFACTORED predicate
+    # (the referee IS ed25519_ref). Cofactorless mode loses OpenSSL's
+    # ref10-exact edge-case acceptance set (non-canonical A) in this
+    # fallback; the edge-vector suite (tests/test_ed25519_edge_vectors.py)
+    # pins that set and only runs where OpenSSL is present.
+    _HAVE_OPENSSL = False
 
 from tendermint_tpu.crypto import tmhash
 
@@ -55,14 +67,34 @@ if _VERIFY_MODE not in ("cofactored", "cofactorless"):
     )
 
 
+# True once the predicate has been CONSULTED (cofactorless_mode() is the
+# single choke point every verification/routing site reads). Lets
+# set_verify_mode surface the process-global last-writer-wins hazard:
+# changing the mode after signatures were already judged under the old one
+# (e.g. two in-process Nodes with differing configs) is silent otherwise.
+_MODE_READ = False
+
+
 def set_verify_mode(mode: str) -> None:
     global _VERIFY_MODE
     if mode not in ("cofactored", "cofactorless"):
         raise ValueError(f"unknown ed25519 verify mode {mode!r}")
+    if mode != _VERIFY_MODE and _MODE_READ:
+        import logging
+
+        logging.getLogger("tendermint_tpu.crypto.keys").warning(
+            "ed25519 verify mode changing %r -> %r after signatures were "
+            "already verified under the old mode; the predicate is "
+            "process-global, so ALL in-process nodes now use %r "
+            "(last writer wins)",
+            _VERIFY_MODE, mode, mode,
+        )
     _VERIFY_MODE = mode
 
 
 def cofactorless_mode() -> bool:
+    global _MODE_READ
+    _MODE_READ = True
     return _VERIFY_MODE == "cofactorless"
 
 
@@ -146,6 +178,16 @@ class Ed25519PubKey(PubKey):
         batches ride the device per-sig kernel, not this wrapper."""
         if len(sig) != SIGNATURE_SIZE:
             return False
+        if not _HAVE_OPENSSL:
+            from tendermint_tpu.crypto import ed25519_ref
+
+            if cofactorless_mode():
+                # pure-Python cofactorless (x/crypto's equation; NOT
+                # ref10-exact on non-canonical A — see the import fallback)
+                return ed25519_ref.verify(self.key_bytes, msg, sig)
+            if not (_canonical_y(self.key_bytes) and _canonical_y(sig[:32])):
+                return False
+            return ed25519_ref.verify_cofactored(self.key_bytes, msg, sig)
         if cofactorless_mode():
             # Reference-exact: delegate ENTIRELY to OpenSSL, including the
             # canonical-encoding prechecks — OpenSSL's ref10-lineage
@@ -194,9 +236,17 @@ class Ed25519PrivKey(PrivKey):
         return self.seed
 
     def sign(self, msg: bytes) -> bytes:
+        if not _HAVE_OPENSSL:
+            from tendermint_tpu.crypto import ed25519_ref
+
+            return ed25519_ref.sign(self.seed, msg)
         return Ed25519PrivateKey.from_private_bytes(self.seed).sign(msg)
 
     def pub_key(self) -> Ed25519PubKey:
+        if not _HAVE_OPENSSL:
+            from tendermint_tpu.crypto import ed25519_ref
+
+            return Ed25519PubKey(ed25519_ref.public_key(self.seed))
         pub = Ed25519PrivateKey.from_private_bytes(self.seed).public_key()
         raw = pub.public_bytes(
             serialization.Encoding.Raw, serialization.PublicFormat.Raw

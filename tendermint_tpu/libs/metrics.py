@@ -266,6 +266,98 @@ class StateMetrics:
         )
 
 
+class BatchVerifyMetrics:
+    """The batch-verify pipeline's flight-recorder metrics (crypto/batch.py,
+    ops/aot_cache.py) plus device-health gauges. No reference counterpart —
+    the reference's serial loop (types/validator_set.go:680-702) has no
+    batch/fallback/compile dynamics to observe. Series catalogue:
+    docs/OBSERVABILITY.md. Registered on the PROCESS-GLOBAL registry (the
+    crypto pipeline is process-global state, shared by every in-process
+    node), which NodeMetrics.expose appends to each node's exposition."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_batch_verify"
+        self.flushes = reg.counter(
+            f"{ns}_flushes_total", "Batch-verify flushes.", ("backend", "path")
+        )
+        self.sigs = reg.counter(
+            f"{ns}_sigs_total", "Signatures submitted per flush path.",
+            ("backend", "path"),
+        )
+        self.batch_size = reg.histogram(
+            f"{ns}_batch_size", "Flush batch sizes (signatures per flush).",
+            buckets=(1, 8, 64, 256, 512, 1024, 2048, 4096, 8192, 16384, 65536),
+        )
+        self.flush_seconds = reg.histogram(
+            f"{ns}_flush_seconds", "End-to-end flush wall seconds.", ("path",)
+        )
+        self.prep_seconds = reg.histogram(
+            f"{ns}_prep_seconds",
+            "Host-prep wall seconds (hashing, scalar math, sorting).",
+        )
+        self.jit_bucket = reg.gauge(
+            f"{ns}_jit_bucket", "Padded jit shape bucket of the last flush."
+        )
+        self.padding_lanes = reg.gauge(
+            f"{ns}_padding_lanes",
+            "Pad lanes wasted by shape bucketing in the last flush.",
+        )
+        self.pubkey_cache_hits = reg.counter(
+            f"{ns}_pubkey_cache_hits_total", "Decompressed-pubkey cache hits."
+        )
+        self.pubkey_cache_misses = reg.counter(
+            f"{ns}_pubkey_cache_misses_total", "Decompressed-pubkey cache misses."
+        )
+        self.rlc_fallbacks = reg.counter(
+            f"{ns}_rlc_fallbacks_total",
+            "RLC combined-check failures recovered via the per-signature path.",
+        )
+        self.compile_seconds = reg.counter(
+            f"{ns}_compile_seconds_total",
+            "Seconds spent tracing/exporting (export) or loading (deserialize) kernels.",
+            ("kind",),
+        )
+        self.transfer_seconds = reg.counter(
+            f"{ns}_transfer_seconds_total",
+            "Seconds blocked in device result sync/fetch.",
+        )
+        # device health (read by bench.py's stall detector and node liveness
+        # via libs.trace.device_health)
+        self.device_up = reg.gauge(
+            f"{NAMESPACE}_device_up",
+            "1 when the last device call succeeded, 0 after a failure/stall.",
+        )
+        self.device_init_seconds = reg.gauge(
+            f"{NAMESPACE}_device_init_seconds",
+            "Wall seconds of jax device/backend initialization.",
+        )
+        self.device_last_call_timestamp = reg.gauge(
+            f"{NAMESPACE}_device_last_call_timestamp_seconds",
+            "Unix time of the last successful device call (age = now - this).",
+        )
+
+
+# Process-global registry: series owned by process-global subsystems (the
+# crypto batch pipeline, the AOT kernel cache) rather than a Node instance.
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL_REGISTRY: Optional[Registry] = None
+_BATCH_METRICS: Optional[BatchVerifyMetrics] = None
+
+
+def global_registry() -> Registry:
+    global _GLOBAL_REGISTRY, _BATCH_METRICS
+    with _GLOBAL_LOCK:
+        if _GLOBAL_REGISTRY is None:
+            _GLOBAL_REGISTRY = Registry()
+            _BATCH_METRICS = BatchVerifyMetrics(_GLOBAL_REGISTRY)
+        return _GLOBAL_REGISTRY
+
+
+def batch_metrics() -> BatchVerifyMetrics:
+    global_registry()
+    return _BATCH_METRICS
+
+
 class NodeMetrics:
     """One registry + all subsystem metric sets
     (reference: node/node.go:106 DefaultMetricsProvider)."""
@@ -278,6 +370,8 @@ class NodeMetrics:
         self.state = StateMetrics(self.registry)
 
     def expose(self) -> str:
-        return self.registry.expose()
+        # node-local series + the process-global batch-verify/device series
+        # (every in-process node shares the one crypto pipeline)
+        return self.registry.expose() + global_registry().expose()
 
 

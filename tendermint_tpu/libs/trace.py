@@ -1,0 +1,390 @@
+"""Flight recorder for the batch-verify pipeline.
+
+Lightweight nested spans and point events into a bounded, thread-safe ring
+buffer with JSONL export — the tracing half of the observability story whose
+metrics half lives in libs/metrics.py (BatchVerifyMetrics). The reference
+wires per-service Prometheus metrics through every subsystem
+(consensus/metrics.go, node/node.go:106-121) but has no in-process tracer;
+this module exists because the single most important path here —
+crypto/batch.py's device pipeline — fails in ways a counter can't localise
+(BENCH_r05: `verify_commit_latency = -1`, "device initialization stalled",
+with zero insight into WHICH stage stalled).
+
+Three consumers:
+
+- the `/debug/trace` RPC route (rpc/server.py) dumps the ring as JSON;
+- `/debug/verify_stats` + bench.py's JSON `extra` read `verify_stats()`,
+  the aggregated per-flush breakdown (prep / compile / transfer / total
+  per path), so a regression names its stage instead of one opaque number;
+- node liveness and the bench's stall detector read `device_health()`
+  (device init duration, last-successful-device-call age, `device_up`).
+
+Overhead contract: when `tracer.enabled` is False the instrumented hot
+paths make ZERO tracer calls beyond one flag read (they hoist
+`tracer if tracer.enabled else None` and skip everything on None), and the
+ring buffer never exceeds its configured size (deque maxlen). Configure via
+`[instrumentation] trace_enabled / trace_ring_size` (node/node.py) or the
+TMTPU_TRACE env default.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+DEFAULT_RING_SIZE = 4096
+
+
+class Span:
+    """An in-flight span; records one event into the tracer's ring on exit.
+
+    Use as a context manager (or call __enter__/__exit__ explicitly when the
+    caller must survive with tracing disabled — see crypto/batch.py).
+    `set(**attrs)` attaches attributes mid-flight (e.g. the chosen path,
+    known only at the end of a flush)."""
+
+    __slots__ = ("_tracer", "name", "attrs", "span_id", "parent_id", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.span_id = tracer._next_id()
+        self.parent_id: Optional[int] = None
+        self._t0 = 0.0
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        stack = self._tracer._stack()
+        self.parent_id = stack[-1] if stack else None
+        stack.append(self.span_id)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dur = time.perf_counter() - self._t0
+        stack = self._tracer._stack()
+        if stack and stack[-1] == self.span_id:
+            stack.pop()
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        self._tracer._record(
+            self.name, self.span_id, self.parent_id, dur, self.attrs
+        )
+
+
+class Tracer:
+    """Thread-safe bounded flight recorder: nested spans + point events."""
+
+    def __init__(self, ring_size: int = DEFAULT_RING_SIZE, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, int(ring_size)))
+        self._local = threading.local()
+        self._id = 0
+
+    # -- recording ----------------------------------------------------------
+
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        """A zero-duration point event, parented to the current span."""
+        stack = self._stack()
+        self._record(name, self._next_id(), stack[-1] if stack else None, None, attrs)
+
+    # -- introspection ------------------------------------------------------
+
+    def dump(self, limit: Optional[int] = None) -> List[dict]:
+        """Ring contents, oldest first (most recent `limit` if given)."""
+        with self._lock:
+            events = list(self._ring)
+        if limit is not None and limit >= 0:
+            events = events[-limit:] if limit else []
+        return events
+
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(e, sort_keys=True) for e in self.dump())
+
+    @staticmethod
+    def from_jsonl(text: str) -> List[dict]:
+        return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+    @property
+    def ring_size(self) -> int:
+        return self._ring.maxlen or 0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def configure(
+        self, enabled: Optional[bool] = None, ring_size: Optional[int] = None
+    ) -> None:
+        """Apply [instrumentation] config; shrinking keeps the newest events."""
+        with self._lock:
+            if ring_size is not None and ring_size != self._ring.maxlen:
+                self._ring = deque(self._ring, maxlen=max(1, int(ring_size)))
+        if enabled is not None:
+            self.enabled = bool(enabled)
+
+    # -- internals ----------------------------------------------------------
+
+    def _next_id(self) -> int:
+        with self._lock:
+            self._id += 1
+            return self._id
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _record(self, name, span_id, parent_id, dur_s, attrs) -> None:
+        event = {
+            "name": name,
+            "span": span_id,
+            "parent": parent_id,
+            "ts": time.time(),
+        }
+        if dur_s is not None:
+            event["dur_ms"] = round(dur_s * 1e3, 4)
+        if attrs:
+            event["attrs"] = dict(attrs)
+        with self._lock:
+            self._ring.append(event)
+
+
+tracer = Tracer(enabled=os.environ.get("TMTPU_TRACE", "1") != "0")
+
+
+# ---------------------------------------------------------------------------
+# Aggregated per-flush telemetry (the /debug/verify_stats + bench `extra`
+# surface) and device-health state (the `device_up` surface). Both also feed
+# the process-global Prometheus series (libs.metrics.batch_metrics) so the
+# node's /metrics exposition carries them without any node->crypto plumbing.
+
+_STATS_LOCK = threading.Lock()
+_TOTALS: Dict[tuple, Dict[str, float]] = {}  # (backend, path) -> counters
+_LAST_FLUSH: Dict[str, Any] = {}
+_COUNTS = {"rlc_fallbacks": 0, "cache_hits": 0, "cache_misses": 0}
+_STAGE_SECONDS = {"prep": 0.0, "compile": 0.0, "transfer": 0.0, "total": 0.0}
+
+_DEVICE_LOCK = threading.Lock()
+_DEVICE: Dict[str, Any] = {
+    "up": None,  # None = no device call attempted yet
+    "init_seconds": None,
+    "last_call_monotonic": None,
+    "last_error": None,
+}
+
+
+def record_flush(
+    *,
+    backend: str,
+    path: str,
+    n: int,
+    total_s: float,
+    n_valid: Optional[int] = None,
+    prep_s: Optional[float] = None,
+    compile_s: Optional[float] = None,
+    transfer_s: Optional[float] = None,
+    jit_bucket: Optional[int] = None,
+    padding_lanes: Optional[int] = None,
+    cache_hits: Optional[int] = None,
+    cache_misses: Optional[int] = None,
+    rlc_fallback: bool = False,
+    tracer_: Optional[Tracer] = None,
+) -> None:
+    """One batch-verify flush completed. Called by crypto/batch.verify_batch
+    for EVERY flush on EVERY backend; `tracer_` is the caller's already-
+    resolved tracer (or None when tracing is disabled) so this function adds
+    no tracer-flag reads of its own."""
+    from tendermint_tpu.libs import metrics as _metrics
+
+    m = _metrics.batch_metrics()
+    m.flushes.labels(backend, path).inc()
+    m.sigs.labels(backend, path).inc(n)
+    m.batch_size.observe(n)
+    m.flush_seconds.labels(path).observe(total_s)
+    if prep_s is not None:
+        m.prep_seconds.observe(prep_s)
+    # compile_s is NOT re-counted into m.compile_seconds here: record_compile
+    # already did, at the aot_cache call site; it rides only the breakdown.
+    if transfer_s is not None:
+        m.transfer_seconds.inc(transfer_s)
+    if jit_bucket is not None:
+        m.jit_bucket.set(jit_bucket)
+    if padding_lanes is not None:
+        m.padding_lanes.set(padding_lanes)
+    if cache_hits:
+        m.pubkey_cache_hits.inc(cache_hits)
+    if cache_misses:
+        m.pubkey_cache_misses.inc(cache_misses)
+    if rlc_fallback:
+        m.rlc_fallbacks.inc()
+
+    last = {
+        "backend": backend,
+        "path": path,
+        "n": n,
+        "total_ms": round(total_s * 1e3, 4),
+    }
+    if n_valid is not None:
+        last["n_valid"] = n_valid
+    if prep_s is not None:
+        last["prep_ms"] = round(prep_s * 1e3, 4)
+    if compile_s is not None:
+        last["compile_ms"] = round(compile_s * 1e3, 4)
+    if transfer_s is not None:
+        last["transfer_ms"] = round(transfer_s * 1e3, 4)
+    if jit_bucket is not None:
+        last["jit_bucket"] = jit_bucket
+        last["padding_lanes"] = padding_lanes
+    if cache_hits is not None or cache_misses is not None:
+        hits, misses = cache_hits or 0, cache_misses or 0
+        last["pubkey_cache_hits"] = hits
+        last["pubkey_cache_misses"] = misses
+        if hits + misses:
+            last["pubkey_cache_hit_rate"] = round(hits / (hits + misses), 4)
+    if rlc_fallback:
+        last["rlc_fallback"] = True
+    with _STATS_LOCK:
+        t = _TOTALS.setdefault(
+            (backend, path), {"flushes": 0, "sigs": 0, "seconds": 0.0}
+        )
+        t["flushes"] += 1
+        t["sigs"] += n
+        t["seconds"] += total_s
+        _COUNTS["cache_hits"] += cache_hits or 0
+        _COUNTS["cache_misses"] += cache_misses or 0
+        if rlc_fallback:
+            _COUNTS["rlc_fallbacks"] += 1
+        _STAGE_SECONDS["prep"] += prep_s or 0.0
+        _STAGE_SECONDS["compile"] += compile_s or 0.0
+        _STAGE_SECONDS["transfer"] += transfer_s or 0.0
+        _STAGE_SECONDS["total"] += total_s
+        _LAST_FLUSH.clear()
+        _LAST_FLUSH.update(last)
+    if tracer_ is not None:
+        tracer_.event("batch_verify.flush", **last)
+
+
+def verify_stats() -> dict:
+    """Aggregated flush telemetry: per-(backend, path) totals, the per-stage
+    time split, and the last flush's breakdown. Shape documented in
+    docs/OBSERVABILITY.md; served by /debug/verify_stats and attached to
+    bench.py's JSON `extra`."""
+    with _STATS_LOCK:
+        totals = {
+            f"{backend}/{path}": dict(t) for (backend, path), t in _TOTALS.items()
+        }
+        out = {
+            "totals": totals,
+            "stage_seconds": dict(_STAGE_SECONDS),
+            "counters": dict(_COUNTS),
+            "last_flush": dict(_LAST_FLUSH),
+        }
+    out["device"] = device_health()
+    return out
+
+
+def reset_stats() -> None:
+    """Test hook: zero the aggregated flush telemetry (not the metrics)."""
+    with _STATS_LOCK:
+        _TOTALS.clear()
+        _LAST_FLUSH.clear()
+        for k in _COUNTS:
+            _COUNTS[k] = 0
+        for k in _STAGE_SECONDS:
+            _STAGE_SECONDS[k] = 0.0
+
+
+# -- device health -----------------------------------------------------------
+
+
+def record_device_init(seconds: float, ok: bool = True, error: str = "") -> None:
+    """Device/backend initialization finished (or stalled: ok=False)."""
+    from tendermint_tpu.libs import metrics as _metrics
+
+    m = _metrics.batch_metrics()
+    with _DEVICE_LOCK:
+        _DEVICE["init_seconds"] = seconds
+        _DEVICE["up"] = bool(ok)
+        _DEVICE["last_error"] = error or None
+        if ok:
+            _DEVICE["last_call_monotonic"] = time.monotonic()
+    m.device_init_seconds.set(seconds)
+    m.device_up.set(1.0 if ok else 0.0)
+    if ok:
+        m.device_last_call_timestamp.set(time.time())
+    if tracer.enabled:
+        tracer.event("device.init", seconds=round(seconds, 4), ok=bool(ok))
+
+
+def mark_device_call(ok: bool = True, error: str = "") -> None:
+    """A device round trip completed (ok) or failed/stalled (not ok) — the
+    signal the bench's stall detector and node liveness read as `device_up`."""
+    from tendermint_tpu.libs import metrics as _metrics
+
+    m = _metrics.batch_metrics()
+    with _DEVICE_LOCK:
+        _DEVICE["up"] = bool(ok)
+        if ok:
+            _DEVICE["last_call_monotonic"] = time.monotonic()
+            _DEVICE["last_error"] = None
+        else:
+            _DEVICE["last_error"] = error or "device call failed"
+    m.device_up.set(1.0 if ok else 0.0)
+    if ok:
+        m.device_last_call_timestamp.set(time.time())
+
+
+def device_health() -> dict:
+    """{"device_up": 0/1/None, "init_seconds", "last_call_age_s", "last_error"}.
+    device_up None means no device call has been attempted this process."""
+    with _DEVICE_LOCK:
+        up = _DEVICE["up"]
+        last = _DEVICE["last_call_monotonic"]
+        return {
+            "device_up": None if up is None else int(up),
+            "init_seconds": _DEVICE["init_seconds"],
+            "last_call_age_s": (
+                round(time.monotonic() - last, 3) if last is not None else None
+            ),
+            "last_error": _DEVICE["last_error"],
+        }
+
+
+# -- compile accounting ------------------------------------------------------
+
+_COMPILE_LOCK = threading.Lock()
+_COMPILE_TOTAL = 0.0  # seconds spent tracing/exporting/deserializing kernels
+
+
+def record_compile(name: str, seconds: float, kind: str) -> None:
+    """ops/aot_cache.py: a kernel trace+export ("export") or artifact load
+    ("deserialize") took `seconds`. Feeds the compile-vs-execute split."""
+    global _COMPILE_TOTAL
+    from tendermint_tpu.libs import metrics as _metrics
+
+    with _COMPILE_LOCK:
+        _COMPILE_TOTAL += seconds
+    _metrics.batch_metrics().compile_seconds.labels(kind).inc(seconds)
+    if tracer.enabled:
+        tracer.event(f"aot.{kind}", kernel=name, seconds=round(seconds, 4))
+
+
+def compile_seconds_total() -> float:
+    """Monotonic compile-time counter; diff around a flush to attribute
+    compile seconds to it (crypto/batch.verify_batch)."""
+    with _COMPILE_LOCK:
+        return _COMPILE_TOTAL

@@ -90,6 +90,15 @@ class Node:
 
         self.metrics = NodeMetrics()
 
+        # flight recorder (libs/trace.py): process-global, same model as the
+        # verify mode above — apply this node's [instrumentation] knobs
+        from tendermint_tpu.libs import trace as _trace
+
+        _trace.tracer.configure(
+            enabled=config.instrumentation.trace_enabled,
+            ring_size=config.instrumentation.trace_ring_size,
+        )
+
         # databases
         self.block_db = _open_db(config, "blockstore")
         self.state_db = _open_db(config, "state")

@@ -20,6 +20,7 @@ import hashlib
 import os
 import tempfile
 import threading
+import time
 from typing import Callable, Dict
 
 import jax
@@ -125,6 +126,8 @@ def call(name: str, jit_fn, *args):
 
 
 def _call_locked(name, key, jit_fn, *args):
+    from tendermint_tpu.libs import trace as _trace
+
     try:
         from jax import export as jexport
 
@@ -134,8 +137,12 @@ def _call_locked(name, key, jit_fn, *args):
         exp = None
         if path and os.path.exists(path):
             try:
+                _t0 = time.perf_counter()
                 with open(path, "rb") as f:
                     exp = jexport.deserialize(bytearray(f.read()))
+                _trace.record_compile(
+                    name, time.perf_counter() - _t0, "deserialize"
+                )
             except Exception:
                 # Corrupted artifact: delete it and fall through to a fresh
                 # export — permanently disabling the AOT path for this key
@@ -152,7 +159,12 @@ def _call_locked(name, key, jit_fn, *args):
                     pass
                 exp = None
         if exp is None:
+            _t0 = time.perf_counter()
             exp = jexport.export(jit_fn)(*args)
+            # trace+lower+export wall time — the "compile" half of the
+            # compile-vs-execute split (XLA's own compile of the artifact
+            # happens inside the first wrapped call, below)
+            _trace.record_compile(name, time.perf_counter() - _t0, "export")
             if path:
                 os.makedirs(d, exist_ok=True)
                 blob = exp.serialize()
@@ -175,4 +187,10 @@ def _call_locked(name, key, jit_fn, *args):
     # Outside the try: a RUNTIME error here (device OOM, transient tunnel
     # failure) must propagate as itself, not be mislabeled as an export
     # failure and permanently disable the AOT path for this key.
-    return wrapped(*args)
+    _t0 = time.perf_counter()
+    out = wrapped(*args)
+    # The first call pays XLA compilation (or persistent-cache load) of the
+    # artifact; recorded as its own kind so compile-vs-execute splits stay
+    # honest — later calls on this key skip _call_locked entirely.
+    _trace.record_compile(name, time.perf_counter() - _t0, "first_call")
+    return out

@@ -41,6 +41,21 @@ def _sweep_stale_tmps(path) -> None:
             pass
 
 
+def _missing_internals(_lru) -> list:
+    """The private jax surface atomic_put re-implements. Instance attributes
+    (path, eviction_enabled, lock, ...) can't be probed without an instance;
+    they are covered by the runtime AttributeError fallback in atomic_put."""
+    needed_module = ("_CACHE_SUFFIX", "_ATIME_SUFFIX")
+    needed_methods = ("put", "_evict_if_needed")
+    missing = [a for a in needed_module if not hasattr(_lru, a)]
+    missing += [
+        m
+        for m in needed_methods
+        if not callable(getattr(_lru.LRUCache, m, None))
+    ]
+    return missing
+
+
 def harden() -> None:
     global _PATCHED
     if _PATCHED:
@@ -51,9 +66,24 @@ def harden() -> None:
         _PATCHED = True
         return
 
+    # Feature-check before monkey-patching: a jax upgrade that moves any of
+    # these internals must degrade to the ORIGINAL (non-atomic) put with a
+    # logged warning, not raise mid-compilation from inside the cache write.
+    missing = _missing_internals(_lru)
+    if missing:
+        import logging
+
+        logging.getLogger("tendermint_tpu.ops.cache_hardening").warning(
+            "jax LRUCache internals changed (missing: %s); skipping "
+            "atomic-write hardening — cache writes stay non-atomic",
+            ", ".join(missing),
+        )
+        _PATCHED = True
+        return
+
     orig_put = _lru.LRUCache.put
 
-    def atomic_put(self, key: str, val: bytes) -> None:
+    def _atomic_put(self, key: str, val: bytes) -> None:
         if not key:
             raise ValueError("key cannot be empty")
         if self.eviction_enabled and len(val) > self.max_size:
@@ -89,6 +119,22 @@ def harden() -> None:
         finally:
             if self.eviction_enabled:
                 self.lock.release()
+
+    def atomic_put(self, key: str, val: bytes) -> None:
+        try:
+            return _atomic_put(self, key, val)
+        except AttributeError as e:
+            # instance-attribute drift the class-level feature check above
+            # can't see: fall back to the unpatched write rather than
+            # failing the compilation that triggered this cache put
+            import logging
+
+            logging.getLogger("tendermint_tpu.ops.cache_hardening").warning(
+                "jax LRUCache instance layout changed (%s); falling back to "
+                "the original non-atomic put",
+                e,
+            )
+            return orig_put(self, key, val)
 
     _lru.LRUCache.put = atomic_put
     _PATCHED = True

@@ -749,6 +749,18 @@ def decompress_rows(rows: np.ndarray) -> Tuple[Tuple[np.ndarray, ...], np.ndarra
     return coords, np.asarray(ok)[:m]
 
 
+def _trace_span(name: str, **attrs):
+    """Flight-recorder span when tracing is on, else a no-op context
+    (libs/trace.py); the submit spans cover host sort + async dispatch."""
+    from tendermint_tpu.libs.trace import tracer
+
+    if tracer.enabled:
+        return tracer.span(name, **attrs)
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
 def rlc_check_submit(
     pts_bytes: np.ndarray, scalars: Sequence[int], zero16_from: int = 0
 ):
@@ -759,13 +771,14 @@ def rlc_check_submit(
     windows). Returns an unsynced device bool (1+N,):
     [batch_ok, lane_ok...] — np.asarray() it to sync."""
     n = pts_bytes.shape[0]
-    digits = scalars_to_bytes(scalars, n)
-    perm, ends = sort_windows(digits, zero16_from=zero16_from)
-    fctx = make_ctx((n,))
-    return aot_cache.call(
-        "rlc_plain", _rlc_jit,
-        np.ascontiguousarray(pts_bytes.T), perm, ends, fctx, make_small_ctx(),
-    )
+    with _trace_span("kernel.rlc_submit", variant="plain", lanes=n):
+        digits = scalars_to_bytes(scalars, n)
+        perm, ends = sort_windows(digits, zero16_from=zero16_from)
+        fctx = make_ctx((n,))
+        return aot_cache.call(
+            "rlc_plain", _rlc_jit,
+            np.ascontiguousarray(pts_bytes.T), perm, ends, fctx, make_small_ctx(),
+        )
 
 
 def rlc_check(pts_bytes: np.ndarray, scalars: Sequence[int]) -> Tuple[bool, np.ndarray]:
@@ -783,31 +796,32 @@ def rlc_check_cached_submit(
     na = a_coords[0].shape[-1]
     nr = r_bytes.shape[0]
     n = na + nr
-    digits = scalars_to_bytes(scalars, n)
-    fctx = make_ctx((nr,))
-    if _device_sort_enabled():
-        # digits go down raw; perm/ends are derived in-graph
-        # (sort_windows_device) — no host sort, half the wire bytes.
+    with _trace_span("kernel.rlc_submit", variant="cached", lanes=n):
+        digits = scalars_to_bytes(scalars, n)
+        fctx = make_ctx((nr,))
+        if _device_sort_enabled():
+            # digits go down raw; perm/ends are derived in-graph
+            # (sort_windows_device) — no host sort, half the wire bytes.
+            return aot_cache.call(
+                "rlc_cached_ds", _rlc_cached_dsort_jit,
+                *a_coords,
+                np.ascontiguousarray(r_bytes.T),
+                digits,
+                fctx,
+                make_small_ctx(),
+            )
+        # rows >= na are the z-lane (128-bit scalars) + padding: zero digits
+        # in windows 16-31, so the sort skips their count pass
+        perm, ends = sort_windows(digits, zero16_from=na)
         return aot_cache.call(
-            "rlc_cached_ds", _rlc_cached_dsort_jit,
+            "rlc_cached", _rlc_cached_jit,
             *a_coords,
             np.ascontiguousarray(r_bytes.T),
-            digits,
+            perm,
+            ends,
             fctx,
             make_small_ctx(),
         )
-    # rows >= na are the z-lane (128-bit scalars) + padding: zero digits in
-    # windows 16-31, so the sort skips their count pass
-    perm, ends = sort_windows(digits, zero16_from=na)
-    return aot_cache.call(
-        "rlc_cached", _rlc_cached_jit,
-        *a_coords,
-        np.ascontiguousarray(r_bytes.T),
-        perm,
-        ends,
-        fctx,
-        make_small_ctx(),
-    )
 
 
 def rlc_check_cached(
@@ -831,17 +845,18 @@ def rlc_check_cached_mixed_submit(
     ne = ed_r_bytes.shape[0]
     ns = sr_r_bytes.shape[0]
     n = na + ne + ns
-    digits = scalars_to_bytes(scalars, n)
-    # rows >= na are the (128-bit) z-lane scalars of both R blocks
-    perm, ends = sort_windows(digits, zero16_from=na)
-    return aot_cache.call(
-        "rlc_mixed", _rlc_cached_mixed_jit,
-        *a_coords,
-        np.ascontiguousarray(ed_r_bytes.T),
-        np.ascontiguousarray(sr_r_bytes.T),
-        perm,
-        ends,
-        make_ctx((ne,)),
-        make_ctx((ns,)),
-        make_small_ctx(),
-    )
+    with _trace_span("kernel.rlc_submit", variant="mixed", lanes=n):
+        digits = scalars_to_bytes(scalars, n)
+        # rows >= na are the (128-bit) z-lane scalars of both R blocks
+        perm, ends = sort_windows(digits, zero16_from=na)
+        return aot_cache.call(
+            "rlc_mixed", _rlc_cached_mixed_jit,
+            *a_coords,
+            np.ascontiguousarray(ed_r_bytes.T),
+            np.ascontiguousarray(sr_r_bytes.T),
+            perm,
+            ends,
+            make_ctx((ne,)),
+            make_ctx((ns,)),
+            make_small_ctx(),
+        )

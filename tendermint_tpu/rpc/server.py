@@ -51,6 +51,10 @@ class RPCServer:
         self.app.router.add_post("/", self._handle_jsonrpc)
         self.app.router.add_get("/metrics", self._handle_metrics)
         self.app.router.add_get("/websocket", self._handle_websocket)
+        # flight-recorder dumps (libs/trace.py); two path segments, so they
+        # need explicit routes ahead of the generic /{method} catch-all
+        self.app.router.add_get("/debug/trace", self._handle_debug_trace)
+        self.app.router.add_get("/debug/verify_stats", self._handle_debug_verify_stats)
         self.app.router.add_get("/{method}", self._handle_uri)
         self.runner: Optional[web.AppRunner] = None
         self._routes = {
@@ -84,6 +88,8 @@ class RPCServer:
             "unsafe_flush_mempool": self._unsafe_flush_mempool,
             "unsafe_dump_stacks": self._unsafe_dump_stacks,
             "unsafe_dump_heap": self._unsafe_dump_heap,
+            "debug_trace": self._debug_trace,
+            "debug_verify_stats": self._debug_verify_stats,
         }
 
     async def start(self) -> None:
@@ -127,6 +133,19 @@ class RPCServer:
             content_type="text/plain",
             charset="utf-8",
         )
+
+    async def _handle_debug_trace(self, request: web.Request) -> web.Response:
+        params = {k: v for k, v in request.query.items()}
+        try:
+            return web.json_response(_result(None, await self._debug_trace(params)))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
+
+    async def _handle_debug_verify_stats(self, request: web.Request) -> web.Response:
+        try:
+            return web.json_response(_result(None, await self._debug_verify_stats({})))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
 
     async def _handle_uri(self, request: web.Request) -> web.Response:
         method = request.match_info["method"]
@@ -720,6 +739,31 @@ class RPCServer:
                 for s in stats
             ],
         }
+
+    async def _debug_trace(self, params) -> dict:
+        """Flight-recorder ring dump (libs/trace.py): the batch-verify
+        pipeline's span tree as JSON, newest-last. ?limit=N returns the most
+        recent N events. Read-only, served regardless of rpc.unsafe (like
+        consensus_state); see docs/OBSERVABILITY.md for the span taxonomy."""
+        from tendermint_tpu.libs import trace
+
+        limit = params.get("limit")
+        events = trace.tracer.dump(int(limit) if limit is not None else None)
+        return {
+            "enabled": trace.tracer.enabled,
+            "ring_size": trace.tracer.ring_size,
+            "count": len(events),
+            "events": events,
+        }
+
+    async def _debug_verify_stats(self, params) -> dict:
+        """Aggregated batch-verify telemetry + device health
+        (libs/trace.verify_stats): per-(backend, path) flush totals, the
+        per-stage time split, the last flush's breakdown, and the
+        device_up/init/last-call-age gauges node liveness reads."""
+        from tendermint_tpu.libs import trace
+
+        return trace.verify_stats()
 
     async def _dial_peers(self, params) -> dict:
         """unsafe route (reference: rpc/core/net.go UnsafeDialPeers)."""

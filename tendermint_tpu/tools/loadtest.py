@@ -101,6 +101,10 @@ async def run_load(
         raise ValueError(f"method must be 'async' or 'sync', not {method!r}")
     if not endpoints:
         raise ValueError("no RPC endpoints given")
+    # Per-RUN nonce in every worker tag: the committed-tx scan matches this
+    # exact prefix, so txs from a concurrent or stale load run (which also
+    # start with b"load-") are never attributed to this one.
+    run_id = os.urandom(4).hex().encode()
     clients = [HTTPClient(ep) for ep in endpoints]
     try:
         status0 = await clients[0].status()
@@ -118,7 +122,7 @@ async def run_load(
                     asyncio.ensure_future(
                         _worker(
                             c, stats[w], stop_at, interval, tx_size, method,
-                            b"load-%d" % w,
+                            b"load-%s-%d" % (run_id, w),
                         )
                     )
                 )
@@ -131,12 +135,13 @@ async def run_load(
 
         status1 = await clients[0].status()
         h1 = int(status1["sync_info"]["latest_block_height"])
-        # count only OUR txs (unique "load-N=" prefix): a net with background
-        # traffic must not inflate the committed numbers. Blocks fetched
-        # concurrently in chunks (serial per-height awaits add one RTT per
-        # block to the report time).
+        # count only OUR txs (unique "load-<runid>-<n>=" prefix): background
+        # traffic AND other load runs' txs must not inflate the committed
+        # numbers. Blocks fetched concurrently in chunks (serial per-height
+        # awaits add one RTT per block to the report time).
         import base64
 
+        run_prefix = b"load-%s-" % run_id
         committed = 0
         heights = list(range(h0 + 1, h1 + 1))
         for c0 in range(0, len(heights), 32):
@@ -145,12 +150,13 @@ async def run_load(
             )
             for blk in blocks:
                 for tx_b64 in blk["block"]["data"]["txs"]:
-                    if base64.b64decode(tx_b64).startswith(b"load-"):
+                    if base64.b64decode(tx_b64).startswith(run_prefix):
                         committed += 1
 
         sent = sum(s.sent for s in stats)
         lats = [x for s in stats for x in s.latencies_ms]
         return {
+            "run_id": run_id.decode(),
             "endpoints": len(endpoints),
             "connections_per_endpoint": max(1, connections),
             "method": method,

@@ -54,6 +54,24 @@ from tendermint_tpu.ops import cache_hardening  # noqa: E402
 cache_hardening.harden()
 
 
+try:
+    import cryptography  # noqa: F401
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # minimal containers: crypto/keys.py falls back to the
+    HAVE_CRYPTOGRAPHY = False  # pure-Python ed25519 (see keys._HAVE_OPENSSL)
+
+import pytest  # noqa: E402
+
+# For tests that need the `cryptography` wheel itself (p2p secret
+# connection, armor's ChaCha/Scrypt, signer-socket auth) or its OpenSSL
+# speed — the pure-Python fallback can't stand in for those.
+requires_cryptography = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="needs the `cryptography` wheel (OpenSSL)",
+)
+
+
 def free_compile_memory() -> None:
     """Drop every previously-compiled executable in this process. Used as a
     module fixture by the heavyweight kernel test modules: XLA ABORTED

@@ -97,6 +97,58 @@ def test_guarded_main_salvages_json_from_crashing_child(tmp_path, monkeypatch):
     assert rep["metric"] == "crashy" and rep["value"] == 9
 
 
+def test_help_documents_flight_recorder_breakdown():
+    """Acceptance: the per-stage breakdown bench attaches to its JSON
+    `extra` is documented in `bench.py --help`."""
+    import subprocess
+
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--help"],
+        capture_output=True,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(_bench().__file__)),
+        timeout=120,
+    )
+    assert p.returncode == 0
+    assert "verify_stats" in p.stdout
+    assert "device_health" in p.stdout
+    assert "stage_seconds" in p.stdout
+
+
+def test_flight_recorder_extra_present_in_results():
+    """extra.verify_stats carries the per-stage breakdown after a CPU flush,
+    and even the stall-fallback JSON includes it (so a -1 result still
+    localises the failed stage)."""
+    import contextlib
+    import io
+
+    bench = _bench()
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.crypto.keys import gen_ed25519
+
+    priv = gen_ed25519(b"\x54" * 32)
+    msgs = [b"bench-extra-%d" % i for i in range(3)]
+    sigs = [priv.sign(m) for m in msgs]
+    assert B.verify_batch(
+        [priv.pub_key().bytes()] * 3, msgs, sigs, backend="cpu"
+    ).all()
+
+    extra = bench._flight_recorder_extra()
+    assert extra["verify_stats"]["totals"]["cpu/cpu"]["flushes"] >= 1
+    assert "stage_seconds" in extra["verify_stats"]
+    assert "last_flush" in extra["verify_stats"]
+    assert "device_up" in extra["device_health"]
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench._emit_fallback("device initialization stalled (test)")
+    rep = json.loads(buf.getvalue())
+    assert rep["value"] == -1
+    assert rep["extra"]["error"].startswith("device initialization stalled")
+    assert "verify_stats" in rep["extra"]
+    assert "device_health" in rep["extra"]
+
+
 def test_guarded_main_emits_fallback_on_dead_child(tmp_path, monkeypatch):
     bench = _bench()
     stub = tmp_path / "dead_bench.py"

@@ -12,6 +12,8 @@ from tendermint_tpu.node.node import Node
 from tendermint_tpu.privval.file_pv import FilePV
 from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
 
+from tests.conftest import requires_cryptography
+
 os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
 
 
@@ -38,6 +40,7 @@ def make_pair(tmp_path):
     return make("source", True), make("syncer", False)
 
 
+@requires_cryptography
 def test_fresh_node_fast_syncs_from_peer(tmp_path):
     async def run():
         source, syncer = make_pair(tmp_path)

@@ -12,6 +12,8 @@ from tendermint_tpu.crypto import (
 from tendermint_tpu.crypto import ed25519_ref as ref
 from tendermint_tpu.crypto import tmhash
 
+from tests.conftest import requires_cryptography
+
 
 def test_rfc8032_test_vector_1():
     # RFC 8032 §7.1 TEST 1 (empty message)
@@ -95,6 +97,7 @@ def test_pubkey_equality_and_bad_sizes():
         Ed25519PrivKey(b"short")
 
 
+@requires_cryptography
 def test_armor_roundtrip_and_tamper():
     """ASCII armor + passphrase encryption for private keys
     (reference models: crypto/armor/armor_test.go + SDK armor tests)."""
@@ -136,6 +139,7 @@ def test_armor_roundtrip_and_tamper():
         decode_armor("not armor at all")
 
 
+@requires_cryptography
 def test_armor_rejects_hostile_headers():
     """Untrusted armor cannot demand huge scrypt memory or escape the
     ArmorError contract."""

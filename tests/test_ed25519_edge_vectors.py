@@ -1,0 +1,155 @@
+""" "Taming the many EdDSAs"-style edge vectors pinning cofactorless mode to
+the reference's documented acceptance set (advisor r5 medium,
+crypto/keys.py:150).
+
+Cofactorless mode delegates ENTIRELY to OpenSSL, asserting (comment-only,
+until now) that OpenSSL's ref10-lineage acceptance set matches the
+reference's golang.org/x/crypto on edge inputs: non-canonical A ACCEPTED,
+non-canonical R REJECTED (by the R-encoding comparison), small-order A
+accepted iff the equation holds exactly, s < L ENFORCED. These vectors make
+an OpenSSL/`cryptography`-wheel drift on any of those decisions fail CI
+instead of silently reintroducing the consensus-fork vector the mode exists
+to close (a mixed fleet forking at the 2/3 boundary).
+
+Vectors are constructed from the pure-Python ground truth
+(crypto/ed25519_ref.py); the assertions run the production host verifier
+(crypto/keys.Ed25519PubKey.verify), i.e. OpenSSL itself.
+"""
+
+import pytest
+
+pytest.importorskip(
+    "cryptography", reason="edge suite pins OpenSSL's acceptance set"
+)
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.crypto import keys
+
+# (0, -1): the canonical order-2 point; enc = (p-1) little-endian, sign 0.
+T2 = (0, ref.P - 1, 1, 0)
+T2_ENC = ref.point_compress(T2)
+# The identity encoded NON-canonically: y-field = p+1 ≡ 1 (mod p), sign 0.
+# Decodes (mod p) to (0, 1) = identity for verifiers that skip the
+# canonical-y check (ref10/x/crypto); ours rejects it in cofactored mode.
+IDENTITY_NONCANONICAL = (ref.P + 1).to_bytes(32, "little")
+
+
+@pytest.fixture
+def cofactorless():
+    keys.set_verify_mode("cofactorless")
+    yield
+    keys.set_verify_mode("cofactored")
+
+
+def _honest(seed: bytes = b"\x15" * 32, msg: bytes = b"edge-honest"):
+    priv = keys.gen_ed25519(seed)
+    return priv.pub_key().bytes(), msg, priv.sign(msg)
+
+
+def _small_order_a_sig(want_accept: bool, msg: bytes = b"edge-small-order"):
+    """Forged signature under the order-2 pubkey A = T2: R = [r]B, s = r, so
+    [s]B - [h]A - R = -[h]T2 — the identity iff h is EVEN. Grind r until the
+    challenge h = SHA512(R||A||M) mod L has the wanted parity: even => exact
+    (cofactorless) verifiers ACCEPT, odd => they REJECT (while the cofactored
+    predicate accepts either way, the defect being pure torsion)."""
+    for r in range(1, 1000):
+        r_enc = ref.point_compress(ref.point_mul(r, ref.BASE))
+        h = ref.sha512_mod_l(r_enc + T2_ENC + msg)
+        if (h % 2 == 0) == want_accept:
+            return T2_ENC, msg, r_enc + r.to_bytes(32, "little")
+    raise AssertionError("no grind hit in 1000 tries (p=1/2 each)")
+
+
+def test_sanity_honest_accept_both_modes(cofactorless):
+    pk, msg, sig = _honest()
+    assert keys.Ed25519PubKey(pk).verify(msg, sig)
+    keys.set_verify_mode("cofactored")
+    assert keys.Ed25519PubKey(pk).verify(msg, sig)
+
+
+def test_s_boundary_rejected_both_modes(cofactorless):
+    """s' = s + L satisfies the verification equation mod L, so ONLY the
+    s < L canonicality check rejects it — the exact drift this vector
+    watches for (signature malleability => consensus fork)."""
+    pk, msg, sig = _honest()
+    s = int.from_bytes(sig[32:], "little")
+    assert s + ref.L < 2**256
+    malleated = sig[:32] + (s + ref.L).to_bytes(32, "little")
+    assert not keys.Ed25519PubKey(pk).verify(msg, malleated)
+    keys.set_verify_mode("cofactored")
+    assert not keys.Ed25519PubKey(pk).verify(msg, malleated)
+    # just below the boundary stays accepted (the check is s < L, not < L-1)
+    keys.set_verify_mode("cofactorless")
+    assert keys.Ed25519PubKey(pk).verify(msg, sig)
+
+
+def test_small_order_a_accepted_when_equation_exact(cofactorless):
+    """ref10/x/crypto do NOT low-order-check A: the forged sig verifies
+    exactly (h even), so cofactorless ACCEPTS. An OpenSSL build that starts
+    rejecting small-order A would diverge from reference peers."""
+    pk, msg, sig = _small_order_a_sig(want_accept=True)
+    assert keys.Ed25519PubKey(pk).verify(msg, sig)
+
+
+def test_small_order_a_rejected_when_torsion_remains(cofactorless):
+    """h odd leaves a live torsion component: cofactorless REJECTS it —
+    while cofactored (our default) accepts, the documented divergence."""
+    pk, msg, sig = _small_order_a_sig(want_accept=False)
+    assert not keys.Ed25519PubKey(pk).verify(msg, sig)
+    keys.set_verify_mode("cofactored")
+    assert keys.Ed25519PubKey(pk).verify(msg, sig)
+
+
+def test_non_canonical_a_accepted_cofactorless_only(cofactorless):
+    """A encoded non-canonically (y-field = p+1 => identity): x/crypto and
+    ref10 reduce y mod p and ACCEPT; our cofactored mode REJECTS at the
+    canonical-encoding precheck (the documented deliberate divergence —
+    non-canonical VALIDATOR keys are blocked at ingestion in both modes)."""
+    msg = b"edge-noncanonical-A"
+    r = 7
+    r_enc = ref.point_compress(ref.point_mul(r, ref.BASE))
+    # A = identity => [h]A vanishes; s = r closes the equation for any h
+    sig = r_enc + r.to_bytes(32, "little")
+    assert keys.Ed25519PubKey(IDENTITY_NONCANONICAL).verify(msg, sig)
+    keys.set_verify_mode("cofactored")
+    assert not keys.Ed25519PubKey(IDENTITY_NONCANONICAL).verify(msg, sig)
+    # and the validator-ingestion gate refuses the encoding in ANY mode
+    with pytest.raises(ValueError):
+        keys.pubkey_from_type_and_bytes("ed25519", IDENTITY_NONCANONICAL)
+
+
+def test_non_canonical_r_rejected_both_modes(cofactorless):
+    """R encoded non-canonically: the point equation holds, but x/crypto
+    compares the CANONICAL encoding of [s]B - [h]A against sig[:32] bytes,
+    so it REJECTS; cofactored requires canonical R outright."""
+    priv = keys.gen_ed25519(b"\x16" * 32)
+    pk = priv.pub_key().bytes()
+    msg = b"edge-noncanonical-R"
+    a_scalar, _prefix = ref.secret_expand(b"\x16" * 32)
+    r_enc = IDENTITY_NONCANONICAL  # R = identity, encoded with y = p+1
+    h = ref.sha512_mod_l(r_enc + pk + msg)
+    s = h * a_scalar % ref.L  # [s]B = [h]A + identity: equation holds
+    sig = r_enc + s.to_bytes(32, "little")
+    assert not keys.Ed25519PubKey(pk).verify(msg, sig)
+    keys.set_verify_mode("cofactored")
+    assert not keys.Ed25519PubKey(pk).verify(msg, sig)
+    # control: the SAME construction with canonical R encoding is accepted
+    keys.set_verify_mode("cofactorless")
+    r_canonical = ref.point_compress(ref.IDENTITY)
+    h2 = ref.sha512_mod_l(r_canonical + pk + msg)
+    sig2 = r_canonical + (h2 * a_scalar % ref.L).to_bytes(32, "little")
+    assert keys.Ed25519PubKey(pk).verify(msg, sig2)
+
+
+def test_torsion_defect_r_agrees_with_suite():
+    """The existing torsion-defect vector (tests/sigutil.py) folded into the
+    suite: cofactored accepts, cofactorless rejects."""
+    from tests.sigutil import torsion_defect_sig
+
+    pk, msg, sig = torsion_defect_sig()
+    assert keys.Ed25519PubKey(pk).verify(msg, sig)
+    keys.set_verify_mode("cofactorless")
+    try:
+        assert not keys.Ed25519PubKey(pk).verify(msg, sig)
+    finally:
+        keys.set_verify_mode("cofactored")

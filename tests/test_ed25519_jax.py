@@ -2,7 +2,9 @@
 
 import pytest
 
-pytestmark = pytest.mark.kernel  # heavy compiles; fast lane: -m 'not kernel'
+pytestmark = [pytest.mark.kernel, pytest.mark.slow]  # heavy one-time
+# compiles: excluded from the tier-1 budget lane (-m 'not slow'); run
+# explicitly via -m kernel
 
 import numpy as np
 

@@ -10,6 +10,9 @@ bigint reference, over odd/even/one-window widths."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # window-fold kernel compiles; excluded
+# from the tier-1 budget lane (-m 'not slow')
+
 from tendermint_tpu.crypto import ed25519_ref as ref
 from tendermint_tpu.ops import fe25519 as fe
 from tendermint_tpu.ops.msm_jax import (

@@ -10,6 +10,8 @@ import pytest
 
 os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
 
+from tests.conftest import requires_cryptography
+
 from tendermint_tpu.libs import amino_json
 from tendermint_tpu.libs import log as tmlog
 from tendermint_tpu.libs.service import (
@@ -97,6 +99,7 @@ def test_amino_json_roundtrip_and_errors():
         amino_json.unmarshal('[1, 2]')
 
 
+@requires_cryptography
 def test_fuzzed_connection_drops_writes():
     import random
 
@@ -132,6 +135,7 @@ def test_fuzzed_connection_drops_writes():
     asyncio.run(go())
 
 
+@requires_cryptography
 def test_debug_dump_cli(tmp_path, capsys):
     from tendermint_tpu.cli.main import init_files, main
 
@@ -149,6 +153,7 @@ def test_debug_dump_cli(tmp_path, capsys):
     assert "config/genesis.json" in names
 
 
+@requires_cryptography
 def test_behaviour_reporter_and_trust_metric():
     """Bad conduct decays trust and eventually disconnects the peer
     (reference models: behaviour/reporter.go, p2p/trust/metric_test.go)."""
@@ -199,6 +204,7 @@ def test_behaviour_reporter_and_trust_metric():
     asyncio.run(go())
 
 
+@requires_cryptography
 def test_signer_harness_cli(capsys):
     from tendermint_tpu.cli.main import main
     from tendermint_tpu.crypto.keys import gen_ed25519

@@ -6,6 +6,17 @@ storm; the report must show sends AND chain-side commits."""
 import asyncio
 import socket
 
+import pytest
+
+from tendermint_tpu.crypto import keys as _keys
+
+# Throughput-shaped thresholds (sent > 50 in 2 s): need OpenSSL-speed host
+# crypto; the pure-Python ed25519 fallback (~ms/op) saturates the event
+# loop and fails them spuriously.
+pytestmark = pytest.mark.skipif(
+    not _keys._HAVE_OPENSSL, reason="needs OpenSSL-speed host crypto"
+)
+
 from tendermint_tpu.abci.kvstore import KVStoreApplication
 from tendermint_tpu.config.config import test_config
 from tendermint_tpu.crypto.keys import gen_ed25519
@@ -58,8 +69,11 @@ def test_load_generator_commits_txs(tmp_path):
             assert report["committed_txs"] > 0, report
             assert report["blocks"] >= 1, report
             assert report["rpc_latency_ms_p50"] > 0, report
-            # every committed tx was one of ours (unique load-N= prefixes)
+            # every committed tx was one of ours: the scan matches this
+            # run's exact "load-<runid>-" prefix, so stale/concurrent load
+            # runs are never counted
             assert report["committed_txs"] <= report["sent"], report
+            assert len(report["run_id"]) == 8, report
         finally:
             await node.stop()
 

@@ -103,6 +103,51 @@ def test_node_metrics_populated_and_served(tmp_path):
     asyncio.run(run())
 
 
+def test_cpu_flush_populates_batch_verify_series():
+    """Acceptance: ONE CPU-backend verify_batch flush produces non-zero
+    tendermint_batch_verify_* series in the Prometheus exposition (the
+    process-global registry every NodeMetrics exposition appends)."""
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.crypto.keys import gen_ed25519
+    from tendermint_tpu.libs.metrics import NodeMetrics
+
+    priv = gen_ed25519(b"\x53" * 32)
+    pk = priv.pub_key().bytes()
+    msgs = [b"metrics-%d" % i for i in range(6)]
+    sigs = [priv.sign(m) for m in msgs]
+    assert B.verify_batch([pk] * 6, msgs, sigs, backend="cpu").all()
+
+    text = NodeMetrics().expose()
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith("tendermint_batch_verify_flushes_total")
+        and 'backend="cpu"' in l and 'path="cpu"' in l
+    )
+    assert float(line.split()[-1]) >= 1
+    sigs_line = next(
+        l for l in text.splitlines()
+        if l.startswith("tendermint_batch_verify_sigs_total") and 'path="cpu"' in l
+    )
+    assert float(sigs_line.split()[-1]) >= 6
+    assert "tendermint_batch_verify_batch_size_bucket" in text
+    assert "tendermint_batch_verify_flush_seconds_count" in text
+    # device-health gauges are part of the same exposition
+    assert "tendermint_device_up" in text
+    assert "tendermint_batch_verify_rlc_fallbacks_total" in text
+
+
+def test_batch_verify_series_shared_across_nodes_registries():
+    """Two NodeMetrics instances expose the SAME process-global batch
+    series (the crypto pipeline is process-global), without duplicate
+    registration errors."""
+    from tendermint_tpu.libs.metrics import NodeMetrics, global_registry
+
+    a, b = NodeMetrics(), NodeMetrics()
+    assert global_registry() is global_registry()
+    assert "tendermint_batch_verify_flushes_total" in a.expose()
+    assert "tendermint_batch_verify_flushes_total" in b.expose()
+
+
 def test_metrics_endpoint_404_when_disabled(tmp_path):
     import aiohttp
 

@@ -11,6 +11,12 @@ import os
 
 import pytest
 
+from tests.conftest import requires_cryptography
+
+# every test here runs a real p2p net (secret connection => the
+# `cryptography` wheel); make_net stays importable for other modules
+pytestmark = requires_cryptography
+
 from tendermint_tpu.abci.kvstore import KVStoreApplication
 from tendermint_tpu.config.config import test_config
 from tendermint_tpu.crypto import gen_ed25519

@@ -6,6 +6,10 @@ import asyncio
 
 import pytest
 
+# module imports reach the p2p stack (secret connection -> the
+# `cryptography` wheel); skip cleanly in minimal containers
+pytest.importorskip("cryptography")
+
 from tendermint_tpu.crypto import gen_ed25519
 from tendermint_tpu.p2p import (
     ChannelDescriptor,

@@ -16,6 +16,8 @@ from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
 from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import Vote
 
+from tests.conftest import requires_cryptography
+
 CHAIN = "remote-chain"
 
 
@@ -92,6 +94,7 @@ def test_sign_proposal_over_socket(signer):
     assert pv.get_pub_key().verify(prop.sign_bytes(CHAIN), signed.signature)
 
 
+@requires_cryptography
 def test_authenticated_signer_rejects_unauthorized_clients():
     """With an allowlist, the connection upgrades to a secret channel and
     only clients holding an authorized key may sign (closes the

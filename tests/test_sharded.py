@@ -5,7 +5,9 @@ sharded_verify and the 2D mesh layout)."""
 
 import pytest
 
-pytestmark = pytest.mark.kernel  # heavy compiles; fast lane: -m 'not kernel'
+pytestmark = [pytest.mark.kernel, pytest.mark.slow]  # heavy one-time
+# compiles: excluded from the tier-1 budget lane (-m 'not slow'); run
+# explicitly via -m kernel
 
 import os
 

@@ -7,6 +7,10 @@ import os
 
 import pytest
 
+# module imports reach the p2p stack (secret connection -> the
+# `cryptography` wheel); skip cleanly in minimal containers
+pytest.importorskip("cryptography")
+
 os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
 
 from tendermint_tpu.abci import types as abci
@@ -21,6 +25,8 @@ from tendermint_tpu.statesync.snapshots import Snapshot, SnapshotPool
 from tendermint_tpu.statesync.stateprovider import LightClientStateProvider
 from tendermint_tpu.types.basic import NANOS
 from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+from tests.conftest import requires_cryptography
 
 
 # ---------------------------------------------------------------- unit tests
@@ -145,6 +151,7 @@ def test_chunk_queue_refetch_earlier_chunk_does_not_deadlock():
 # ------------------------------------------------------------------ e2e test
 
 
+@requires_cryptography
 def test_node_bootstraps_from_peer_snapshot(tmp_path):
     """A fresh node state-syncs from a peer's snapshot (no replay), then
     block-syncs the tail and joins consensus

@@ -7,6 +7,10 @@ import asyncio
 import socket
 
 import pytest
+
+# module imports reach the p2p stack (secret connection -> the
+# `cryptography` wheel); skip cleanly in minimal containers
+pytest.importorskip("cryptography")
 from aiohttp import web
 
 from tendermint_tpu.p2p.upnp import NAT, UPNPError, discover, probe

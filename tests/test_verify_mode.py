@@ -12,6 +12,8 @@ from tendermint_tpu.crypto import batch as B
 from tendermint_tpu.crypto import keys
 from tests.sigutil import torsion_defect_sig
 
+from tests.conftest import requires_cryptography
+
 
 @pytest.fixture
 def _restore_mode():
@@ -49,6 +51,27 @@ def test_set_verify_mode_validates():
         keys.set_verify_mode("bogus")
 
 
+def test_mode_change_after_verification_warns(caplog, _restore_mode):
+    """The predicate is process-global: changing it after signatures were
+    already judged under the old mode (multi-node-in-process configs
+    disagreeing) must be VISIBLE, not silent last-writer-wins (advisor r5
+    low, crypto/keys.py:57)."""
+    import logging
+
+    priv = keys.gen_ed25519(b"\x14" * 32)
+    assert priv.pub_key().verify(b"warn", priv.sign(b"warn"))  # consults mode
+    with caplog.at_level(logging.WARNING, logger="tendermint_tpu.crypto.keys"):
+        keys.set_verify_mode("cofactorless")
+    assert any(
+        "last writer wins" in r.getMessage() for r in caplog.records
+    ), caplog.records
+    # re-setting the SAME mode stays silent
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="tendermint_tpu.crypto.keys"):
+        keys.set_verify_mode("cofactorless")
+    assert not caplog.records
+
+
 def test_env_mode_validated_at_import():
     import subprocess
     import sys
@@ -82,6 +105,7 @@ def test_cofactorless_delegates_prechecks_to_openssl(_restore_mode, monkeypatch)
         priv.pub_key().verify(b"delegate", sig)
 
 
+@requires_cryptography
 def test_node_resets_poisoned_global_mode(tmp_path):
     """A Node whose config says 'cofactored' must actively reset a
     process-global 'cofactorless' left by an earlier Node or env (the
