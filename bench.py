@@ -41,6 +41,14 @@ Flight-recorder breakdown (always in "extra", including the stall fallback):
                   last_call_age_s, last_error — so a
                   "verify_commit_latency = -1" run names the stalled stage
                   instead of reporting one opaque number.
+  node_metrics  — node/consensus metrics snapshot from the most recent
+                  in-process Node (the live_consensus / vote_storm
+                  sub-benchmarks): every written node-local Prometheus
+                  series as {name: {type, series}}, histograms collapsed to
+                  count+sum — chain-side context (step/round durations,
+                  block intervals, commit-verify seconds) next to the
+                  device-side verify_stats. null when no sub-benchmark
+                  constructed a node.
 
 Run WITHOUT the test conftest (needs the real TPU): `python bench.py`.
 """
@@ -821,14 +829,25 @@ def _flight_recorder_extra() -> dict:
     module docstring / --help): future BENCH_r*.json files localise a
     regression to prep vs compile vs transfer vs path choice instead of
     reporting one opaque latency."""
+    out = {}
     try:
         from tendermint_tpu.libs import trace as _trace
 
         stats = _trace.verify_stats()
         device = stats.pop("device", None)
-        return {"verify_stats": stats, "device_health": device}
+        out["verify_stats"] = stats
+        out["device_health"] = device
     except Exception as e:  # never lose the bench result to telemetry
-        return {"verify_stats": {"error": repr(e)}}
+        out["verify_stats"] = {"error": repr(e)}
+    try:  # independent of the trace read above — a tracer failure must not
+        # also cost the chain-side snapshot
+        from tendermint_tpu.libs.metrics import NodeMetrics
+
+        nm = NodeMetrics.latest()
+        out["node_metrics"] = nm.snapshot() if nm is not None else None
+    except Exception as e:
+        out["node_metrics"] = {"error": repr(e)}
+    return out
 
 
 def _emit_fallback(err: str) -> None:

@@ -40,9 +40,12 @@ class _Requester:
 
 
 class BlockPool:
-    def __init__(self, start_height: int, send_request: Callable, punish_peer: Callable):
-        """send_request(peer_id, height) -> awaitable; punish_peer(peer_id, reason)."""
+    def __init__(self, start_height: int, send_request: Callable, punish_peer: Callable,
+                 metrics=None):
+        """send_request(peer_id, height) -> awaitable; punish_peer(peer_id, reason);
+        metrics: an optional BlockSyncMetrics (num_peers / latest_block_height)."""
         self.height = start_height  # next height to pop
+        self.metrics = metrics
         self._peers: Dict[str, _PoolPeer] = {}
         self._requesters: Dict[int, _Requester] = {}
         self._send_request = send_request
@@ -66,9 +69,13 @@ class BlockPool:
         if p is None:
             p = self._peers[peer_id] = _PoolPeer(peer_id)
         p.base, p.height = base, height
+        if self.metrics is not None:
+            self.metrics.num_peers.set(len(self._peers))
 
     def remove_peer(self, peer_id: str) -> None:
         self._peers.pop(peer_id, None)
+        if self.metrics is not None:
+            self.metrics.num_peers.set(len(self._peers))
         for req in self._requesters.values():
             if req.peer_id == peer_id and req.block is None:
                 req.peer_id = ""
@@ -107,6 +114,8 @@ class BlockPool:
         """first block was applied: advance (reference: pool.go PopRequest)."""
         self._requesters.pop(self.height, None)
         self.height += 1
+        if self.metrics is not None:
+            self.metrics.latest_block_height.set(self.height)
 
     def redo_request(self, height: int) -> str:
         """first/second failed validation: punish the sender, refetch

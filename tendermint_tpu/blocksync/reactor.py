@@ -39,13 +39,15 @@ VERIFY_BATCH_BLOCKS = 16
 
 
 class BlocksyncReactor(Reactor):
-    def __init__(self, state, block_exec, block_store, consensus_reactor=None, active: bool = True):
+    def __init__(self, state, block_exec, block_store, consensus_reactor=None,
+                 active: bool = True, metrics=None):
         super().__init__("BLOCKSYNC")
         self.state = state
         self.block_exec = block_exec
         self.block_store = block_store
         self.consensus_reactor = consensus_reactor
         self.active = active  # False = serve blocks only (we're not syncing)
+        self.metrics = metrics  # BlockSyncMetrics or None
         self.pool: Optional[BlockPool] = None
         self._tasks: List[asyncio.Task] = []
         self.synced = asyncio.Event()
@@ -58,8 +60,11 @@ class BlocksyncReactor(Reactor):
         if not self.active:
             return
         self._started_at = time.monotonic()
+        if self.metrics is not None:
+            self.metrics.syncing.set(1)
         self.pool = BlockPool(
-            self.state.last_block_height + 1, self._send_request, self._punish_peer
+            self.state.last_block_height + 1, self._send_request, self._punish_peer,
+            metrics=self.metrics,
         )
         self.pool.start()
         self._tasks = [
@@ -221,11 +226,16 @@ class BlocksyncReactor(Reactor):
 
                 # batched verification across blocks x validators (the TPU
                 # showcase: one kernel launch for the whole run)
+                _tv0 = time.perf_counter()
                 bad = self._verify_run_batched(run)
+                if self.metrics is not None:
+                    self.metrics.verify_seconds.observe(time.perf_counter() - _tv0)
                 n_ok = len(run) if bad is None else bad
                 for first, parts, second in run[:n_ok]:
                     self._apply(first, parts, second)
                     self.pool.pop_request()
+                if n_ok and self.metrics is not None:
+                    self.metrics.blocks_applied_total.inc(n_ok)
                 if bad == 0:
                     # failed against the verified-current valset: bad data.
                     # punish both providers of the offending pair and refetch
@@ -267,6 +277,8 @@ class BlocksyncReactor(Reactor):
 
     async def _switch_to_consensus(self) -> None:
         logger.info("fast sync complete at height %d; switching to consensus", self.state.last_block_height)
+        if self.metrics is not None:
+            self.metrics.syncing.set(0)
         self.pool.stop()
         for t in self._tasks:
             if t is not asyncio.current_task():

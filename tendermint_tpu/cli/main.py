@@ -596,6 +596,18 @@ def main(argv=None) -> int:
     sub.add_parser("replay-console", help="interactive WAL replay (n/rs/q)")
 
     sp = sub.add_parser(
+        "wal-inspect",
+        help="post-mortem: rebuild the consensus timeline (heights/rounds/steps, "
+             "vote arrival, EndHeight gaps) from a WAL, offline and read-only",
+    )
+    sp.add_argument(
+        "--wal", default="",
+        help="WAL head file; defaults to the home's consensus.wal_path",
+    )
+    sp.add_argument("--limit", type=int, default=None,
+                    help="only the most recent N heights")
+
+    sp = sub.add_parser(
         "probe-upnp", help="probe the local NAT for UPnP port-mapping support"
     )
     sp.add_argument("--port", type=int, default=26656)
@@ -609,7 +621,9 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser(
         "load-test",
-        help="tx load generator: spam a running net over RPC, report send + commit throughput",
+        help="tx load generator: spam a running net over RPC, report send + commit "
+             "throughput plus chain-side block-interval/step-duration summaries "
+             "scraped from /metrics (chain_metrics; null if not served)",
     )
     sp.add_argument(
         "--endpoints", default="http://127.0.0.1:26657",
@@ -699,6 +713,20 @@ def main(argv=None) -> int:
         run_replay(args.home, console=False)
     elif args.cmd == "replay-console":
         run_replay(args.home, console=True)
+    elif args.cmd == "wal-inspect":
+        from tendermint_tpu.tools.wal_inspect import inspect_wal
+
+        wal_path = args.wal
+        if not wal_path:
+            cfg = load_home(args.home)
+            wal_path = (
+                cfg.consensus.wal_path
+                if os.path.isabs(cfg.consensus.wal_path)
+                else cfg.path(cfg.consensus.wal_path)
+            )
+        if not os.path.exists(wal_path):
+            raise SystemExit(f"WAL not found: {wal_path!r} (pass --wal)")
+        print(json.dumps(inspect_wal(wal_path, limit=args.limit), indent=1))
     elif args.cmd == "probe-upnp":
         # (reference: cmd/tendermint/commands/probe_upnp.go)
         from tendermint_tpu.p2p.upnp import UPNPError, probe

@@ -163,6 +163,10 @@ class InstrumentationConfig:
     # constructed in a process wins.
     trace_enabled: bool = True
     trace_ring_size: int = 4096
+    # consensus timeline ring (consensus/timeline.py): most-recent heights
+    # kept for GET /debug/consensus_timeline and post-mortem diffing against
+    # `wal-inspect`. Node-local; recording follows trace_enabled.
+    timeline_heights: int = 128
 
 
 @dataclass

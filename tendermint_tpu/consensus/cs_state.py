@@ -93,9 +93,22 @@ class ConsensusState:
         event_bus: Optional[EventBus] = None,
         priv_validator=None,
         metrics=None,
+        timeline=None,
     ):
         self.config = config
         self.metrics = metrics
+        # per-height/round timeline ring (consensus/timeline.py), served by
+        # GET /debug/consensus_timeline; recording is gated on tracer.enabled
+        # so a disabled recorder costs the hot path only flag checks
+        self.timeline = timeline
+        # (height, round, step, perf_counter) of the current step, and
+        # (height, round, perf_counter) of the current round — the clocks
+        # behind step_duration_seconds / round_duration_seconds
+        self._step_clock = None
+        self._round_clock = None
+        # (height, round) pairs already recorded by the prevote-delay gauges
+        self._quorum_prevote_marked = None
+        self._full_prevote_marked = None
         self.block_exec = block_exec
         self.block_store = block_store
         self.tx_notifier = tx_notifier
@@ -305,6 +318,8 @@ class ConsensusState:
         elif step == RoundStepType.NEW_ROUND:
             self._enter_propose(ti.height, 0)
         elif step == RoundStepType.PROPOSE:
+            if self.metrics is not None:
+                self.metrics.proposal_timeout_total.inc()
             self._publish_rs(EVENT_TIMEOUT_PROPOSE)
             self._enter_prevote(ti.height, ti.round)
         elif step == RoundStepType.PREVOTE_WAIT:
@@ -433,8 +448,57 @@ class ConsensusState:
         # read-only).
         if self._running:
             self.wal.write(EventRoundState(rs.height, rs.round, int(rs.step)))
+        self._mark_step()
         self.n_steps += 1
         self._publish_rs(EVENT_NEW_ROUND_STEP)
+
+    def _tl(self):
+        """The timeline iff recording is on — tracing disabled reduces every
+        timeline call site to this one flag check (same contract as
+        libs/trace.py's hoisted `tracer if tracer.enabled else None`)."""
+        tl = self.timeline
+        if tl is None or not _tracer.enabled or self.replay_mode:
+            return None
+        return tl
+
+    def _mark_step(self) -> None:
+        """Close the previous step's duration and open the new one — the
+        analog of the reference's metrics.MarkStep (CometBFT
+        consensus/metrics.go RecordConsMetrics)."""
+        rs = self.rs
+        cur = (rs.height, rs.round, rs.step)
+        prev = self._step_clock
+        if prev is not None and prev[:3] == cur:
+            return  # _new_step without a step change (e.g. precommit-wait arm)
+        now = time.perf_counter()
+        if prev is not None and self.metrics is not None and not self.replay_mode:
+            self.metrics.step_duration_seconds.labels(prev[2].name.lower()).observe(
+                now - prev[3]
+            )
+        self._step_clock = (rs.height, rs.round, rs.step, now)
+        tl = self._tl()
+        if tl is not None:
+            tl.record_step(rs.height, rs.round, rs.step.name)
+            # also drop a point event into the flight-recorder ring so
+            # /debug/trace interleaves consensus steps with verify spans
+            _tracer.event(
+                "consensus.step",
+                height=rs.height, round=rs.round, step=rs.step.name,
+            )
+
+    def _mark_round(self, height: int, round_: int) -> None:
+        """Round clock: observe the previous round's duration when the round
+        escalates; _finalize_commit observes the committing round."""
+        now = time.perf_counter()
+        prev = self._round_clock
+        if prev is not None and prev[0] == height and prev[1] == round_:
+            return
+        if (
+            prev is not None and self.metrics is not None and not self.replay_mode
+            and prev[0] == height and prev[1] < round_
+        ):
+            self.metrics.round_duration_seconds.observe(now - prev[2])
+        self._round_clock = (height, round_, now)
 
     def _publish_rs(self, event_type: str) -> None:
         if self.event_bus is not None:
@@ -459,6 +523,7 @@ class ConsensusState:
             validators = validators.copy()
             validators.increment_proposer_priority(round_ - rs.round)
 
+        self._mark_round(height, round_)
         rs.round = round_
         rs.step = RoundStepType.NEW_ROUND
         rs.validators = validators
@@ -468,6 +533,9 @@ class ConsensusState:
             rs.proposal_block_parts = None
         rs.votes.set_round(round_ + 1)  # track next round too
         rs.triggered_timeout_precommit = False
+        self._mark_step()  # NEW_ROUND has no _new_step of its own
+        if self.metrics is not None and not self.replay_mode:
+            self.metrics.rounds.set(round_)
         self._publish_rs(EVENT_NEW_ROUND)
 
         wait_for_txs = (
@@ -544,6 +612,9 @@ class ConsensusState:
             if not self.replay_mode:
                 logger.error("enterPropose: error signing proposal: %s", e)
             return
+        m = self._live_metrics()
+        if m is not None:
+            m.proposal_create_count.inc()
         self.send_internal(ProposalMessage(proposal))
         for i in range(block_parts.total):
             self.send_internal(BlockPartMessage(height, round_, block_parts.get_part(i)))
@@ -588,15 +659,27 @@ class ConsensusState:
         if proposal.height != rs.height or proposal.round != rs.round:
             return
         if proposal.pol_round < -1 or (proposal.pol_round >= 0 and proposal.pol_round >= proposal.round):
+            m = self._live_metrics()
+            if m is not None:
+                m.proposal_receive_count.labels("rejected").inc()
             raise VoteSetError("error invalid proposal POL round")
         proposer = rs.validators.get_proposer()
         if not proposer.pub_key.verify(
             proposal.sign_bytes(self.state.chain_id), proposal.signature
         ):
+            m = self._live_metrics()
+            if m is not None:
+                m.proposal_receive_count.labels("rejected").inc()
             raise VoteSetError("error invalid proposal signature")
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(proposal.block_id.part_set_header)
+        m = self._live_metrics()
+        if m is not None:
+            m.proposal_receive_count.labels("accepted").inc()
+        tl = self._tl()
+        if tl is not None:
+            tl.record_proposal(proposal.height, proposal.round)
         logger.info("received proposal %s", proposal.height)
 
     def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str) -> None:
@@ -836,8 +919,23 @@ class ConsensusState:
 
         logger.info("finalizing commit of block %d txs=%d hash=%s",
                     block.header.height, len(block.txs), block.hash().hex()[:12])
+        tl = self._tl()
+        if tl is not None:
+            tl.record_commit(height, rs.commit_round, txs=len(block.txs))
         if self.metrics is not None:
             m = self.metrics
+            if (
+                not self.replay_mode
+                and self._round_clock is not None
+                and self._round_clock[:2] == (height, rs.commit_round)
+            ):
+                # replay re-runs commits at replay speed, and a commit of an
+                # EARLIER round after escalation (late precommits) belongs
+                # to a round the clock no longer tracks — both would record
+                # bogus near-zero samples in the low buckets
+                m.round_duration_seconds.observe(
+                    time.perf_counter() - self._round_clock[2]
+                )
             m.commit_verify_seconds.observe(_tv1 - _tv0)
             m.num_txs.set(len(block.txs))
             m.total_txs.inc(len(block.txs))
@@ -862,6 +960,8 @@ class ConsensusState:
         # EndHeight marker: blockstore has the block; recovery runs ApplyBlock
         # via handshake if we crash after this point.
         self.wal.write_end_height(height)
+        if tl is not None:
+            tl.record_end_height(height)
         fail.fail_point("cs_after_wal_endheight")
 
         state_copy = self.state.copy()
@@ -980,11 +1080,17 @@ class ConsensusState:
         # Late precommit for the previous height (during commit timeout).
         if vote.height + 1 == rs.height and vote.type == SignedMsgType.PRECOMMIT:
             if rs.step != RoundStepType.NEW_HEIGHT:
+                m = self._live_metrics()
+                if m is not None:
+                    m.late_votes.labels(vote.type.name.lower()).inc()
                 return False
             if rs.last_commit is None:
                 return False
             added = rs.last_commit.add_vote(vote)
             if not added:
+                m = self._live_metrics()
+                if m is not None:
+                    m.duplicate_votes.inc()
                 return False
             if added != "pending":  # unverified: published at flush instead
                 self.event_bus.publish_vote(vote)
@@ -993,11 +1099,22 @@ class ConsensusState:
             return True
 
         if vote.height != rs.height:
+            m = self._live_metrics()
+            if vote.height < rs.height and m is not None:
+                m.late_votes.labels(vote.type.name.lower()).inc()
             return False
 
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
+            # VoteSet.add_vote returns falsy ONLY for exact duplicates
+            # (same validator, block, signature) — everything else raises
+            m = self._live_metrics()
+            if m is not None:
+                m.duplicate_votes.inc()
             return False
+        tl = self._tl()
+        if tl is not None:
+            tl.record_vote(vote.height, vote.round, vote.type.name)
         if added == "pending":
             # Deferred verification: the vote is queued, not verified — do
             # NOT publish (the reactor would broadcast HasVote and peers
@@ -1023,6 +1140,7 @@ class ConsensusState:
         if vtype == SignedMsgType.PREVOTE:
             prevotes = rs.votes.prevotes(vround)
             block_id = prevotes.two_thirds_majority()
+            self._mark_prevote_delays(prevotes, vround, block_id)
             if block_id is not None:
                 # Unlock on newer polka for a different block.
                 if (
@@ -1075,6 +1193,32 @@ class ConsensusState:
             elif rs.round <= vround and precommits.has_two_thirds_any():
                 self._enter_new_round(height, vround)
                 self._enter_precommit_wait(height, vround)
+
+    def _live_metrics(self):
+        """Metrics sink, muted during WAL replay — catchup re-processes old
+        messages at replay speed and must not re-count them."""
+        return None if self.replay_mode else self.metrics
+
+    def _mark_prevote_delays(self, prevotes, vround: int, block_id) -> None:
+        """quorum_prevote_delay / full_prevote_delay: seconds from the
+        proposal's signed timestamp to 2/3 (resp. all) prevote arrival
+        (reference: CometBFT consensus/state.go addVote's
+        QuorumPrevoteDelay/FullPrevoteDelay gauges). Recorded once per
+        (height, round) so trailing prevotes don't inflate the value."""
+        rs = self.rs
+        if (
+            self.metrics is None or self.replay_mode
+            or rs.proposal is None or rs.proposal.round != vround
+        ):
+            return
+        delay = max(0.0, (time.time_ns() - rs.proposal.timestamp_ns) / 1e9)
+        key = (rs.height, vround)
+        if block_id is not None and self._quorum_prevote_marked != key:
+            self._quorum_prevote_marked = key
+            self.metrics.quorum_prevote_delay.set(delay)
+        if prevotes.has_all() and self._full_prevote_marked != key:
+            self._full_prevote_marked = key
+            self.metrics.full_prevote_delay.set(delay)
 
     def _sign_vote(self, msg_type: SignedMsgType, block_hash: bytes, psh: PartSetHeader) -> Optional[Vote]:
         rs = self.rs

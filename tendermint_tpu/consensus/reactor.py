@@ -318,6 +318,28 @@ class ConsensusReactor(Reactor):
                 ps.apply_proposal_pol(msg)
             elif isinstance(msg, BlockPartMessage):
                 ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
+                m = self.cs.metrics
+                if m is not None:
+                    # block-part gossip timing (reference: CometBFT
+                    # consensus/metrics.go BlockGossipPartsReceived /
+                    # BlockGossipReceiveLatency)
+                    matches = msg.height == rs.height and msg.round == rs.round
+                    m.block_parts.labels("true" if matches else "false").inc()
+                    if matches:
+                        # origin: the round's proposal; before it arrives,
+                        # fall back to the height start — valid for round 0
+                        # only (start_time_ns is per-height, and counting a
+                        # failed earlier round as gossip latency would
+                        # pollute the tail)
+                        origin_ns = 0
+                        if rs.proposal is not None:
+                            origin_ns = rs.proposal.timestamp_ns
+                        elif rs.round == 0:
+                            origin_ns = rs.start_time_ns
+                        if origin_ns:
+                            m.block_gossip_receive_latency.observe(
+                                max(0.0, (time.time_ns() - origin_ns) / 1e9)
+                            )
                 await self.cs.add_peer_message(msg, peer.id)
         elif chan_id == VOTE_CHANNEL:
             if self.wait_sync:

@@ -1,0 +1,172 @@
+"""Per-height/round consensus timeline ring.
+
+The distributed-system complement of libs/trace.py's device-side flight
+recorder: a bounded, thread-safe record of WHERE each height spent its time
+— step entries, round escalations, proposal/vote arrival, commit — kept as
+structured per-height records instead of a flat span ring, so one GET of
+`/debug/consensus_timeline` answers "why was height H slow?" without
+grepping logs. The reference exposes only the *current* round state
+(rpc/core/consensus.go DumpConsensusState); history dies with the round.
+
+Two producers share this format:
+
+- the live ConsensusState (consensus/cs_state.py) feeds wall-clock events
+  while running (gated on `tracer.enabled`: with tracing off the hot path
+  pays only flag checks and the ring stays empty);
+- the offline WAL inspector (tools/wal_inspect.py) replays a crashed or
+  slow node's WAL into the same structure, deriving timestamps from the
+  signed vote/proposal times embedded in the messages.
+
+Overhead contract: every record_* call is a few dict/list operations under
+one lock; per-round vote arrivals aggregate into a fixed bucket histogram
+(VOTE_ARRIVAL_BUCKETS_MS), never an unbounded list.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+DEFAULT_MAX_HEIGHTS = 128
+
+# vote-arrival offsets from round start, cumulative buckets in milliseconds
+VOTE_ARRIVAL_BUCKETS_MS = (5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000)
+
+# default for record_* ts args: "stamp with wall-clock now". The offline WAL
+# inspector instead passes an explicit float (derived from signed message
+# timestamps) or None ("no time reference yet" — the record is kept, its
+# durations stay undefined).
+_NOW = object()
+
+
+class ConsensusTimeline:
+    """Bounded ring of per-height consensus records, oldest evicted first."""
+
+    def __init__(self, max_heights: int = DEFAULT_MAX_HEIGHTS):
+        self.max_heights = max(1, int(max_heights))
+        self._lock = threading.Lock()
+        self._heights: "OrderedDict[int, dict]" = OrderedDict()
+
+    # -- recording ----------------------------------------------------------
+
+    def _rec(self, height: int) -> dict:
+        rec = self._heights.get(height)
+        if rec is None:
+            rec = {
+                "height": height,
+                "steps": [],  # [{"round", "step", "ts"}] in arrival order
+                "round_start": {},  # round -> ts of its first step
+                "proposals": [],  # [{"round", "ts"}]
+                "votes": {},  # round -> {"prevote", "precommit", "arrival_ms"}
+                "commit": None,  # {"round", "ts", "txs"}
+                "end_height_ts": None,
+            }
+            self._heights[height] = rec
+            while len(self._heights) > self.max_heights:
+                self._heights.popitem(last=False)
+        return rec
+
+    def record_step(self, height: int, round_: int, step: str, ts=_NOW) -> None:
+        ts = time.time() if ts is _NOW else ts
+        with self._lock:
+            rec = self._rec(height)
+            rec["steps"].append({"round": round_, "step": step, "ts": ts})
+            if ts is not None:
+                rec["round_start"].setdefault(round_, ts)
+
+    def record_proposal(self, height: int, round_: int, ts=_NOW) -> None:
+        ts = time.time() if ts is _NOW else ts
+        with self._lock:
+            self._rec(height)["proposals"].append({"round": round_, "ts": ts})
+
+    def record_vote(self, height: int, round_: int, vote_type: str, ts=_NOW) -> None:
+        ts = time.time() if ts is _NOW else ts
+        key = "prevote" if "PREVOTE" in vote_type.upper() else "precommit"
+        with self._lock:
+            rec = self._rec(height)
+            votes = rec["votes"].get(round_)
+            if votes is None:
+                votes = rec["votes"][round_] = {
+                    "prevote": 0,
+                    "precommit": 0,
+                    "arrival_ms": [0] * (len(VOTE_ARRIVAL_BUCKETS_MS) + 1),
+                }
+            votes[key] += 1
+            start = rec["round_start"].get(round_)
+            if start is not None and ts is not None:
+                off_ms = max(0.0, (ts - start) * 1e3)
+                for i, b in enumerate(VOTE_ARRIVAL_BUCKETS_MS):
+                    if off_ms <= b:
+                        votes["arrival_ms"][i] += 1
+                        break
+                else:
+                    votes["arrival_ms"][-1] += 1
+
+    def record_commit(self, height: int, round_: int, txs: int = 0, ts=_NOW) -> None:
+        ts = time.time() if ts is _NOW else ts
+        with self._lock:
+            self._rec(height)["commit"] = {"round": round_, "ts": ts, "txs": txs}
+
+    def record_end_height(self, height: int, ts=_NOW) -> None:
+        ts = time.time() if ts is _NOW else ts
+        with self._lock:
+            self._rec(height)["end_height_ts"] = ts
+
+    # -- introspection ------------------------------------------------------
+
+    def dump(self, limit: Optional[int] = None) -> List[dict]:
+        """Time-ordered per-height records (ascending height; the most
+        recent `limit` heights if given). Step durations are derived on the
+        way out: each step's `dur_s` is the gap to the next recorded step of
+        the same height (the last step stays open-ended)."""
+        with self._lock:
+            heights = [self._copy_rec(r) for r in self._heights.values()]
+        heights.sort(key=lambda r: r["height"])
+        if limit is not None and limit >= 0:
+            heights = heights[-limit:] if limit else []
+        for rec in heights:
+            steps = rec["steps"]
+            for i, st in enumerate(steps):
+                nxt = steps[i + 1]["ts"] if i + 1 < len(steps) else None
+                if nxt is not None and st["ts"] is not None:
+                    # clamp: WAL-reconstructed timestamps come from different
+                    # validators' clocks, so skew could make the gap negative
+                    st["dur_s"] = round(max(0.0, nxt - st["ts"]), 6)
+            # rounds the state machine actually ENTERED (steps/commit) —
+            # votes are excluded: next-round and peer-catchup votes arrive
+            # for rounds this node never escalated to, and counting them
+            # would fabricate round escalations in the report
+            rounds = {s["round"] for s in steps}
+            if rec["commit"] is not None:
+                rounds.add(rec["commit"]["round"])
+            rec["round_count"] = (max(rounds) + 1) if rounds else 0
+            commit = rec["commit"]
+            start = rec["round_start"].get(0)
+            if commit is not None and commit["ts"] is not None and start is not None:
+                rec["total_s"] = round(max(0.0, commit["ts"] - start), 6)
+            # internal bookkeeping, derivable from steps[] — not API surface
+            rec.pop("round_start", None)
+        return heights
+
+    def _copy_rec(self, rec: dict) -> dict:
+        out = dict(rec)
+        out["steps"] = [dict(s) for s in rec["steps"]]
+        out["proposals"] = [dict(p) for p in rec["proposals"]]
+        out["votes"] = {
+            r: {**v, "arrival_ms": list(v["arrival_ms"])}
+            for r, v in rec["votes"].items()
+        }
+        out["round_start"] = dict(rec["round_start"])
+        if rec["commit"] is not None:
+            out["commit"] = dict(rec["commit"])
+        return out
+
+    def heights(self) -> List[int]:
+        with self._lock:
+            return sorted(self._heights)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._heights.clear()

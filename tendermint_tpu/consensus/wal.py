@@ -111,6 +111,56 @@ class CorruptedWALError(Exception):
     pass
 
 
+def wal_files(path: str) -> List[str]:
+    """All files of a rotated WAL group, oldest first (….000, …, head)."""
+    files = []
+    idx = 0
+    while os.path.exists(f"{path}.{idx:03d}"):
+        files.append(f"{path}.{idx:03d}")
+        idx += 1
+    if os.path.exists(path):
+        files.append(path)
+    return files
+
+
+def iter_wal_messages(path: str, strict: bool = False) -> Iterator[WALMessage]:
+    """Decode all messages across a WAL group WITHOUT opening it for append
+    (the WAL class constructor writes an EndHeight(0) anchor into fresh
+    files — a read-only consumer like tools/wal_inspect.py must never do
+    that to a post-mortem artifact). Non-strict mode stops at the first
+    corrupted frame (torn write at crash)."""
+    for fname in wal_files(path):
+        with open(fname, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos < len(data):
+            if pos + 8 > len(data):
+                if strict:
+                    raise CorruptedWALError("truncated frame header")
+                return
+            crc, length = struct.unpack_from(">II", data, pos)
+            if length > MAX_MSG_SIZE_BYTES:
+                if strict:
+                    raise CorruptedWALError("frame too large")
+                return
+            if pos + 8 + length > len(data):
+                if strict:
+                    raise CorruptedWALError("truncated frame body")
+                return
+            body = data[pos + 8 : pos + 8 + length]
+            if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                if strict:
+                    raise CorruptedWALError("crc mismatch")
+                return
+            try:
+                yield _decode_wal_message(body)
+            except ValueError:
+                if strict:
+                    raise CorruptedWALError("undecodable message")
+                return
+            pos += 8 + length
+
+
 class WAL:
     """Size-rotated WAL. Files: <path>, <path>.000, <path>.001 … (rotated
     heads); head is always <path>."""
@@ -195,48 +245,12 @@ class WAL:
     # -- reading ------------------------------------------------------------
 
     def _all_files(self) -> List[str]:
-        files = []
-        idx = 0
-        while os.path.exists(f"{self.path}.{idx:03d}"):
-            files.append(f"{self.path}.{idx:03d}")
-            idx += 1
-        if os.path.exists(self.path):
-            files.append(self.path)
-        return files
+        return wal_files(self.path)
 
     def iter_messages(self, strict: bool = False) -> Iterator[WALMessage]:
         """Decode all messages across rotated files. Non-strict mode stops at
         the first corrupted frame (torn write at crash)."""
-        for fname in self._all_files():
-            with open(fname, "rb") as f:
-                data = f.read()
-            pos = 0
-            while pos < len(data):
-                if pos + 8 > len(data):
-                    if strict:
-                        raise CorruptedWALError("truncated frame header")
-                    return
-                crc, length = struct.unpack_from(">II", data, pos)
-                if length > MAX_MSG_SIZE_BYTES:
-                    if strict:
-                        raise CorruptedWALError("frame too large")
-                    return
-                if pos + 8 + length > len(data):
-                    if strict:
-                        raise CorruptedWALError("truncated frame body")
-                    return
-                body = data[pos + 8 : pos + 8 + length]
-                if zlib.crc32(body) & 0xFFFFFFFF != crc:
-                    if strict:
-                        raise CorruptedWALError("crc mismatch")
-                    return
-                try:
-                    yield _decode_wal_message(body)
-                except ValueError:
-                    if strict:
-                        raise CorruptedWALError("undecodable message")
-                    return
-                pos += 8 + length
+        yield from iter_wal_messages(self.path, strict=strict)
 
     def search_for_end_height(self, height: int) -> Optional[List[WALMessage]]:
         """Returns messages AFTER EndHeightMessage(height), or None if the

@@ -193,6 +193,79 @@ class Registry:
             lines.extend(m.expose())
         return "\n".join(lines) + "\n"
 
+    def snapshot(self) -> dict:
+        """Compact JSON-able dump of every series that has recorded data:
+        {name: {"type", "series": {label_str: value | {"count","sum"}}}}.
+        Histograms collapse to count+sum (the bucket layout is an exposition
+        concern); series never written are omitted to keep snapshots small
+        (bench.py attaches this as `extra.node_metrics`)."""
+        with self._lock:
+            metrics = list(self._metrics)
+        out: Dict[str, dict] = {}
+        for m in metrics:
+            if isinstance(m, Histogram):
+                with m._lock:
+                    series = {
+                        _fmt_labels(m.label_names, lv).strip("{}"): {
+                            "count": m._totals[lv],
+                            "sum": round(m._sums[lv], 6),
+                        }
+                        for lv in m._totals
+                    }
+            else:
+                with m._lock:
+                    series = {
+                        _fmt_labels(m.label_names, lv).strip("{}"): v
+                        for lv, v in m._values.items()
+                    }
+            if series:
+                out[m.name] = {"type": m.kind, "series": series}
+        return out
+
+
+def parse_exposition(text: str) -> Dict[str, dict]:
+    """Strict parser for the Prometheus text format Registry.expose emits:
+    {family: {"help", "type", "samples": [(name, labels_dict, value)]}}.
+    Sample names carry the _bucket/_sum/_count suffixes; shared by the
+    exposition lint test and tools/loadtest.py's /metrics scrape."""
+    import re as _re
+
+    families: Dict[str, dict] = {}
+    sample_re = _re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$")
+    label_re = _re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            families.setdefault(name, {"help": None, "type": None, "samples": []})
+            families[name]["help"] = help_
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            families.setdefault(name, {"help": None, "type": None, "samples": []})
+            families[name]["type"] = kind.strip()
+        elif line.startswith("#"):
+            raise ValueError(f"unknown comment line: {line!r}")
+        else:
+            m = sample_re.match(line)
+            if m is None:
+                raise ValueError(f"unparseable sample line: {line!r}")
+            name, _, labels_s, value_s = m.groups()
+            labels = dict(label_re.findall(labels_s)) if labels_s else {}
+            value = float("inf") if value_s == "+Inf" else float(value_s)
+            family = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                base = name[: -len(suffix)] if name.endswith(suffix) else None
+                if base and families.get(base, {}).get("type") == "histogram":
+                    family = base
+                    break
+            if family not in families:
+                raise ValueError(f"sample {name!r} before HELP/TYPE")
+            families[family]["samples"].append((name, labels, value))
+    return families
+
 
 # ------------------------------------------------- per-subsystem metric sets
 
@@ -226,6 +299,55 @@ class ConsensusMetrics:
             f"{ns}_commit_verify_seconds",
             "Wall time of (batched) commit signature verification.",
         )
+        # step/round latency (reference: CometBFT consensus/metrics.go
+        # StepDurationSeconds/RoundDurationSeconds, added v0.38)
+        step_buckets = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+        self.step_duration_seconds = reg.histogram(
+            f"{ns}_step_duration_seconds",
+            "Wall seconds spent in each consensus step.",
+            ("step",), buckets=step_buckets,
+        )
+        self.round_duration_seconds = reg.histogram(
+            f"{ns}_round_duration_seconds",
+            "Wall seconds from round entry to commit or round escalation.",
+            buckets=step_buckets,
+        )
+        self.quorum_prevote_delay = reg.gauge(
+            f"{ns}_quorum_prevote_delay",
+            "Seconds from the proposal timestamp to +2/3 prevote quorum (last round).",
+        )
+        self.full_prevote_delay = reg.gauge(
+            f"{ns}_full_prevote_delay",
+            "Seconds from the proposal timestamp to 100% of prevotes (last round).",
+        )
+        self.proposal_receive_count = reg.counter(
+            f"{ns}_proposal_receive_count",
+            "Proposals processed, by outcome.", ("status",)
+        )
+        self.proposal_create_count = reg.counter(
+            f"{ns}_proposal_create_count", "Proposals created by this node."
+        )
+        self.proposal_timeout_total = reg.counter(
+            f"{ns}_proposal_timeout_total",
+            "Propose-step timeouts (the node prevoted nil for lack of a proposal).",
+        )
+        self.late_votes = reg.counter(
+            f"{ns}_late_votes_total",
+            "Votes received for an earlier height.", ("vote_type",)
+        )
+        self.duplicate_votes = reg.counter(
+            f"{ns}_duplicate_votes_total", "Exact-duplicate votes dropped."
+        )
+        self.block_parts = reg.counter(
+            f"{ns}_block_parts_total",
+            "Block parts received from peer gossip.", ("matches_current",)
+        )
+        self.block_gossip_receive_latency = reg.histogram(
+            f"{ns}_block_gossip_receive_latency",
+            "Seconds from the proposal timestamp (round start before the "
+            "proposal arrives) to each gossiped block part's arrival.",
+            buckets=step_buckets,
+        )
 
 
 class MempoolMetrics:
@@ -234,6 +356,9 @@ class MempoolMetrics:
     def __init__(self, reg: Registry):
         ns = f"{NAMESPACE}_mempool"
         self.size = reg.gauge(f"{ns}_size", "Transactions in the mempool.")
+        self.size_bytes = reg.gauge(
+            f"{ns}_size_bytes", "Total bytes of transactions in the mempool."
+        )
         self.tx_size_bytes = reg.histogram(
             f"{ns}_tx_size_bytes", "Transaction sizes.",
             buckets=(32, 128, 512, 2048, 8192, 65536, 1048576),
@@ -254,6 +379,20 @@ class P2PMetrics:
         self.peer_send_bytes_total = reg.counter(
             f"{ns}_peer_send_bytes_total", "Bytes sent per channel.", ("chID",)
         )
+        # flowrate gauges fed from the MConnection Monitors (libs/flowrate.py)
+        # by the switch's periodic sampler (p2p/switch.py _flowrate_routine)
+        self.send_rate_bytes = reg.gauge(
+            f"{ns}_send_rate_bytes",
+            "EWMA aggregate send rate across all peers (bytes/s).",
+        )
+        self.recv_rate_bytes = reg.gauge(
+            f"{ns}_recv_rate_bytes",
+            "EWMA aggregate receive rate across all peers (bytes/s).",
+        )
+        self.pending_send_messages = reg.gauge(
+            f"{ns}_pending_send_messages",
+            "Messages waiting in per-channel send queues, summed over peers.",
+        )
 
 
 class StateMetrics:
@@ -263,6 +402,53 @@ class StateMetrics:
         ns = f"{NAMESPACE}_state"
         self.block_processing_time = reg.histogram(
             f"{ns}_block_processing_time", "ApplyBlock wall seconds.",
+        )
+
+
+class BlockSyncMetrics:
+    """reference: blocksync/metrics.go (Syncing gauge) plus the TPU path's
+    batched-verification timing that the reference's serial loop lacks."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_blocksync"
+        self.syncing = reg.gauge(
+            f"{ns}_syncing", "1 while block sync (fast sync) is running."
+        )
+        self.num_peers = reg.gauge(
+            f"{ns}_num_peers", "Peers the block pool can request from."
+        )
+        self.blocks_applied_total = reg.counter(
+            f"{ns}_blocks_applied_total", "Blocks applied by block sync."
+        )
+        self.latest_block_height = reg.gauge(
+            f"{ns}_latest_block_height", "Next height the pool will fetch."
+        )
+        self.verify_seconds = reg.histogram(
+            f"{ns}_verify_seconds",
+            "Wall seconds per batched commit-verification run (blocks x validators).",
+        )
+
+
+class StateSyncMetrics:
+    """reference: the statesync half of node monitoring (the reference has
+    no statesync metrics.go; series names follow its conventions)."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_statesync"
+        self.syncing = reg.gauge(
+            f"{ns}_syncing", "1 while a state sync (snapshot restore) is running."
+        )
+        self.snapshots_discovered_total = reg.counter(
+            f"{ns}_snapshots_discovered_total", "Distinct snapshots offered by peers."
+        )
+        self.snapshot_height = reg.gauge(
+            f"{ns}_snapshot_height", "Height of the snapshot being restored."
+        )
+        self.snapshot_chunks_total = reg.gauge(
+            f"{ns}_snapshot_chunks_total", "Chunk count of the snapshot being restored."
+        )
+        self.chunks_applied_total = reg.counter(
+            f"{ns}_chunks_applied_total", "Snapshot chunks applied via ABCI."
         )
 
 
@@ -362,12 +548,28 @@ class NodeMetrics:
     """One registry + all subsystem metric sets
     (reference: node/node.go:106 DefaultMetricsProvider)."""
 
+    _latest: Optional["NodeMetrics"] = None
+
     def __init__(self):
         self.registry = Registry()
         self.consensus = ConsensusMetrics(self.registry)
         self.mempool = MempoolMetrics(self.registry)
         self.p2p = P2PMetrics(self.registry)
         self.state = StateMetrics(self.registry)
+        self.blocksync = BlockSyncMetrics(self.registry)
+        self.statesync = StateSyncMetrics(self.registry)
+        NodeMetrics._latest = self
+
+    @classmethod
+    def latest(cls) -> Optional["NodeMetrics"]:
+        """Most recently constructed instance (bench.py snapshots the node
+        its sub-benchmarks ran, without plumbing the object out)."""
+        return cls._latest
+
+    def snapshot(self) -> dict:
+        """Node-local written series only (the process-global batch-verify
+        series ride bench's `extra.verify_stats` already)."""
+        return self.registry.snapshot()
 
     def expose(self) -> str:
         # node-local series + the process-global batch-verify/device series

@@ -186,6 +186,7 @@ class Mempool:
                     self.metrics.failed_txs.inc()
             if self.metrics is not None:
                 self.metrics.size.set(len(self._txs))
+                self.metrics.size_bytes.set(self._total_bytes)
                 self.metrics.tx_size_bytes.observe(len(tx))
             return res
 
@@ -248,6 +249,7 @@ class Mempool:
             self._recheck_txs()
         if self.metrics is not None:
             self.metrics.size.set(len(self._txs))
+            self.metrics.size_bytes.set(self._total_bytes)
         if self._txs:
             self._notify_txs_available()
 

@@ -99,6 +99,15 @@ class Node:
             ring_size=config.instrumentation.trace_ring_size,
         )
 
+        # per-height/round consensus timeline ring (consensus/timeline.py) —
+        # node-local (unlike the tracer), served by /debug/consensus_timeline;
+        # recording is gated on the tracer's enabled flag in cs_state
+        from tendermint_tpu.consensus.timeline import ConsensusTimeline
+
+        self.timeline = ConsensusTimeline(
+            max_heights=config.instrumentation.timeline_heights
+        )
+
         # databases
         self.block_db = _open_db(config, "blockstore")
         self.state_db = _open_db(config, "state")
@@ -187,6 +196,7 @@ class Node:
             event_bus=self.event_bus,
             priv_validator=priv_validator,
             metrics=self.metrics.consensus,
+            timeline=self.timeline,
         )
 
         self.rpc_server = None
@@ -268,12 +278,14 @@ class Node:
                 state, self.block_exec, self.block_store,
                 consensus_reactor=self.consensus_reactor,
                 active=self.fast_sync and not self.state_sync,
+                metrics=self.metrics.blocksync,
             )
             self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
             from tendermint_tpu.statesync.reactor import StatesyncReactor
 
             self.statesync_reactor = StatesyncReactor(
-                self.proxy_app.snapshot, self.proxy_app.query, active=self.state_sync
+                self.proxy_app.snapshot, self.proxy_app.query, active=self.state_sync,
+                metrics=self.metrics.statesync,
             )
             self.switch.add_reactor("STATESYNC", self.statesync_reactor)
             if config.p2p.pex:

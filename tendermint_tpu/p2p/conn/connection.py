@@ -150,6 +150,28 @@ class MConnection:
         self._send_event.set()
         return True
 
+    def status(self) -> dict:
+        """Flowrate + queue-depth snapshot (reference: connection.go:270
+        Status/ConnectionStatus): the per-peer read side of the Monitors
+        that previously only throttled. Feeds net_info's connection_status
+        and the switch's p2p flowrate gauges."""
+        return {
+            "send_rate_bytes": round(self._send_monitor.status_rate(), 1),
+            "recv_rate_bytes": round(self._recv_monitor.status_rate(), 1),
+            "send_bytes_total": self._send_monitor.total,
+            "recv_bytes_total": self._recv_monitor.total,
+            "channels": [
+                {
+                    "id": ch.desc.id,
+                    "priority": ch.desc.priority,
+                    "pending_messages": ch.send_queue.qsize()
+                    + (1 if ch.sent_pos < len(ch.sending) else 0),
+                    "recently_sent": round(ch.recently_sent, 1),
+                }
+                for ch in self._channels.values()
+            ],
+        }
+
     # -- internals ---------------------------------------------------------
 
     def _pick_channel(self) -> Optional[_Channel]:

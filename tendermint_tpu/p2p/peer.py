@@ -40,6 +40,11 @@ class Peer:
     def try_send(self, chan_id: int, msg: bytes) -> bool:
         return self.mconn.try_send(chan_id, msg)
 
+    def status(self) -> dict:
+        """Per-connection flowrate/queue snapshot (reference: p2p/peer.go
+        Status -> ConnectionStatus); surfaced in net_info."""
+        return self.mconn.status()
+
     def set(self, key: str, value) -> None:
         self._data[key] = value
 

@@ -73,6 +73,7 @@ class Switch:
         return reactor
 
     TRUST_SAVE_INTERVAL = 60.0  # reference: p2p/trust/store.go saves each minute
+    FLOWRATE_SAMPLE_INTERVAL = 2.0  # p2p gauge refresh (EWMA window is 1s)
 
     async def start(self) -> None:
         self._running = True
@@ -83,11 +84,40 @@ class Switch:
             self._tasks.append(
                 asyncio.create_task(self._trust_save_routine(), name="sw-trust-save")
             )
+        if self.metrics is not None:
+            self._tasks.append(
+                asyncio.create_task(self._flowrate_routine(), name="sw-flowrate")
+            )
 
     async def _trust_save_routine(self) -> None:
         while self._running:
             await asyncio.sleep(self.TRUST_SAVE_INTERVAL)
             self.reporter.save()
+
+    async def _flowrate_routine(self) -> None:
+        """Periodically fold every peer MConnection's flowrate Monitors and
+        send-queue depths into the p2p gauges — the Monitors existed for
+        rate limiting but were never read for observability."""
+        while self._running:
+            self.update_flow_metrics()
+            await asyncio.sleep(self.FLOWRATE_SAMPLE_INTERVAL)
+
+    def update_flow_metrics(self) -> None:
+        if self.metrics is None:
+            return
+        send_rate = recv_rate = 0.0
+        pending = 0
+        for peer in self.peers.list():
+            try:
+                st = peer.status()
+            except Exception:
+                continue
+            send_rate += st["send_rate_bytes"]
+            recv_rate += st["recv_rate_bytes"]
+            pending += sum(c["pending_messages"] for c in st["channels"])
+        self.metrics.send_rate_bytes.set(send_rate)
+        self.metrics.recv_rate_bytes.set(recv_rate)
+        self.metrics.pending_send_messages.set(pending)
 
     async def stop(self) -> None:
         self._running = False

@@ -86,6 +86,19 @@ class HTTPClient:
             raise RPCError(err.get("code", -1), err.get("message", ""), err.get("data", ""))
         return body.get("result")
 
+    async def metrics_text(self) -> Optional[str]:
+        """Raw Prometheus exposition from the node's /metrics route, or None
+        when instrumentation is disabled (404) or the GET fails — scrapers
+        like tools/loadtest.py degrade instead of erroring."""
+        session = await self._ensure()
+        try:
+            async with session.get(self.base_url + "/metrics") as resp:
+                if resp.status != 200:
+                    return None
+                return await resp.text()
+        except Exception:
+            return None
+
     # convenience wrappers (the route set mirrors rpc/core/routes.go)
     async def status(self):
         return await self.call("status")

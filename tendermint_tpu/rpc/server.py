@@ -55,6 +55,9 @@ class RPCServer:
         # need explicit routes ahead of the generic /{method} catch-all
         self.app.router.add_get("/debug/trace", self._handle_debug_trace)
         self.app.router.add_get("/debug/verify_stats", self._handle_debug_verify_stats)
+        self.app.router.add_get(
+            "/debug/consensus_timeline", self._handle_debug_consensus_timeline
+        )
         self.app.router.add_get("/{method}", self._handle_uri)
         self.runner: Optional[web.AppRunner] = None
         self._routes = {
@@ -90,6 +93,7 @@ class RPCServer:
             "unsafe_dump_heap": self._unsafe_dump_heap,
             "debug_trace": self._debug_trace,
             "debug_verify_stats": self._debug_verify_stats,
+            "consensus_timeline": self._consensus_timeline,
         }
 
     async def start(self) -> None:
@@ -144,6 +148,15 @@ class RPCServer:
     async def _handle_debug_verify_stats(self, request: web.Request) -> web.Response:
         try:
             return web.json_response(_result(None, await self._debug_verify_stats({})))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
+
+    async def _handle_debug_consensus_timeline(self, request: web.Request) -> web.Response:
+        params = {k: v for k, v in request.query.items()}
+        try:
+            return web.json_response(
+                _result(None, await self._consensus_timeline(params))
+            )
         except Exception as e:
             return web.json_response(_error(None, -32603, "internal error", str(e)))
 
@@ -765,6 +778,26 @@ class RPCServer:
 
         return trace.verify_stats()
 
+    async def _consensus_timeline(self, params) -> dict:
+        """Per-height/round consensus timeline ring
+        (consensus/timeline.py): time-ordered step entries with derived
+        durations, round escalations, proposal/vote arrival and commit per
+        height. ?limit=N returns the most recent N heights. Degrades
+        gracefully: with tracing disabled (or no timeline wired) it reports
+        enabled=false and whatever records exist (none if tracing was never
+        on). Read-only; same taxonomy as `wal-inspect`'s offline report."""
+        from tendermint_tpu.libs import trace
+
+        tl = getattr(self.node.consensus, "timeline", None)
+        limit = params.get("limit")
+        heights = tl.dump(int(limit) if limit is not None else None) if tl else []
+        return {
+            "enabled": bool(tl is not None and trace.tracer.enabled),
+            "max_heights": tl.max_heights if tl is not None else 0,
+            "count": len(heights),
+            "heights": heights,
+        }
+
     async def _dial_peers(self, params) -> dict:
         """unsafe route (reference: rpc/core/net.go UnsafeDialPeers)."""
         self._require_unsafe()
@@ -795,6 +828,9 @@ class RPCServer:
                     "is_outbound": p.outbound,
                     "remote_ip": p.socket_addr,
                     "trust_score": round(sw.reporter.score(p.id), 4),
+                    # flowrate Monitors + send-queue depths (reference:
+                    # p2p/peer.go Status → rpc/core/net.go NetInfo)
+                    "connection_status": p.status(),
                 }
                 for p in sw.peers.list()
             ],

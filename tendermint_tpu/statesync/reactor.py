@@ -37,11 +37,12 @@ RECENT_SNAPSHOTS = 10  # reference: statesync/reactor.go:73
 
 
 class StatesyncReactor(Reactor):
-    def __init__(self, conn_snapshot, conn_query, active: bool = False):
+    def __init__(self, conn_snapshot, conn_query, active: bool = False, metrics=None):
         super().__init__("STATESYNC")
         self.conn_snapshot = conn_snapshot
         self.conn_query = conn_query
         self.active = active  # True = we are syncing; False = serve only
+        self.metrics = metrics  # StateSyncMetrics or None
         self.syncer: Optional[Syncer] = None
 
     def get_channels(self) -> List[ChannelDescriptor]:
@@ -140,7 +141,10 @@ class StatesyncReactor(Reactor):
             self._request_chunk,
             chunk_fetchers=chunk_fetchers,
             chunk_timeout=chunk_timeout,
+            metrics=self.metrics,
         )
+        if self.metrics is not None:
+            self.metrics.syncing.set(1)
         try:
             # ask everyone already connected (late peers hit add_peer)
             await self.switch.broadcast(
@@ -149,6 +153,8 @@ class StatesyncReactor(Reactor):
             return await self.syncer.sync_any(discovery_time)
         finally:
             self.syncer = None
+            if self.metrics is not None:
+                self.metrics.syncing.set(0)
 
     async def _request_chunk(self, peer_id: str, height: int, fmt: int, index: int) -> None:
         peer = self.switch.peers.get(peer_id)

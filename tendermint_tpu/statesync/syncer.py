@@ -68,6 +68,7 @@ class Syncer:
         request_chunk: Callable,
         chunk_fetchers: int = 4,
         chunk_timeout: float = CHUNK_TIMEOUT,
+        metrics=None,
     ):
         self.state_provider = state_provider
         self.conn_snapshot = conn_snapshot
@@ -75,6 +76,7 @@ class Syncer:
         self.request_chunk = request_chunk
         self.chunk_fetchers = chunk_fetchers
         self.chunk_timeout = chunk_timeout
+        self.metrics = metrics  # StateSyncMetrics or None
         self.snapshots = SnapshotPool()
         self.chunk_queue: Optional[ChunkQueue] = None
         self._processing: Optional[Snapshot] = None
@@ -85,6 +87,8 @@ class Syncer:
         """reference: syncer.go:78 AddSnapshot."""
         added = self.snapshots.add(peer_id, snapshot)
         if added:
+            if self.metrics is not None:
+                self.metrics.snapshots_discovered_total.inc()
             logger.info(
                 "discovered snapshot height=%d format=%d chunks=%d from %s",
                 snapshot.height, snapshot.format, snapshot.chunks, peer_id[:10],
@@ -147,6 +151,9 @@ class Syncer:
         )
         self._processing = snapshot
         self.chunk_queue = ChunkQueue(snapshot)
+        if self.metrics is not None:
+            self.metrics.snapshot_height.set(snapshot.height)
+            self.metrics.snapshot_chunks_total.set(snapshot.chunks)
 
         await self._offer_snapshot(snapshot)
 
@@ -250,6 +257,8 @@ class Syncer:
 
             r = resp.result
             if r == abci.APPLY_SNAPSHOT_CHUNK_ACCEPT:
+                if self.metrics is not None:
+                    self.metrics.chunks_applied_total.inc()
                 if q.done():
                     return
             elif r == abci.APPLY_SNAPSHOT_CHUNK_ABORT:

@@ -12,7 +12,12 @@ about consensus keeping up.
 
 Output: one dict/JSON with send-side stats (sent, errors, achieved rate,
 RPC latency percentiles) and chain-side stats (blocks, committed txs,
-committed tx/s, blocks/s) over the run window.
+committed tx/s, blocks/s) over the run window. When the target node serves
+/metrics (instrumentation.prometheus = true), `chain_metrics` adds the
+consensus-side view of the SAME window scraped as exposition deltas:
+`block_interval_avg_s` and per-step `step_duration_avg_s` — RPC latency
+percentiles say how fast the node answers, these say where consensus spent
+the time; `chain_metrics` is null when /metrics is unavailable.
 """
 
 from __future__ import annotations
@@ -21,7 +26,7 @@ import asyncio
 import os
 import time
 from dataclasses import dataclass, field
-from typing import List
+from typing import List, Optional
 
 from tendermint_tpu.rpc.client import HTTPClient
 
@@ -40,6 +45,72 @@ def _percentile(xs: List[float], q: float) -> float:
     xs = sorted(xs)
     i = min(len(xs) - 1, int(q * (len(xs) - 1)))
     return xs[i]
+
+
+def _hist_sums(families: dict, name: str) -> dict:
+    """{label_key: (count, sum)} for one histogram family in a
+    parse_exposition result."""
+    fam = families.get(name)
+    out: dict = {}
+    if fam is None:
+        return out
+    for sample_name, labels, value in fam["samples"]:
+        key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        cnt, tot = out.get(key, (0.0, 0.0))
+        if sample_name.endswith("_count"):
+            cnt = value
+        elif sample_name.endswith("_sum"):
+            tot = value
+        else:
+            continue
+        out[key] = (cnt, tot)
+    return out
+
+
+def _chain_metrics_delta(text0: Optional[str], text1: Optional[str]) -> Optional[dict]:
+    """Consensus-side summary of the load window from two /metrics scrapes:
+    average block interval and per-step durations over the DELTA (counts and
+    sums are monotonic, so before/after subtraction isolates the window)."""
+    if not text0 or not text1:
+        return None
+    try:
+        return _chain_metrics_delta_strict(text0, text1)
+    except Exception:
+        # the degrade contract: a foreign/unparseable exposition (another
+        # node implementation, a proxy error page) must not cost the report
+        return None
+
+
+def _chain_metrics_delta_strict(text0: str, text1: str) -> dict:
+    from tendermint_tpu.libs.metrics import parse_exposition
+
+    fams0, fams1 = parse_exposition(text0), parse_exposition(text1)
+
+    def avg_delta(name: str) -> dict:
+        h0, h1 = _hist_sums(fams0, name), _hist_sums(fams1, name)
+        out = {}
+        for key, (c1, s1) in h1.items():
+            c0, s0 = h0.get(key, (0.0, 0.0))
+            dc, ds = c1 - c0, s1 - s0
+            label = ",".join(f"{k}={v}" for k, v in key) or "_"
+            out[label] = {
+                "observations": int(dc),
+                "avg_s": round(ds / dc, 6) if dc > 0 else None,
+            }
+        return out
+
+    interval = avg_delta("tendermint_consensus_block_interval_seconds").get("_")
+    return {
+        "block_interval_avg_s": interval["avg_s"] if interval else None,
+        "block_intervals_observed": interval["observations"] if interval else 0,
+        "step_duration_avg_s": {
+            label.partition("=")[2]: v["avg_s"]
+            for label, v in avg_delta(
+                "tendermint_consensus_step_duration_seconds"
+            ).items()
+            if label.startswith("step=")
+        },
+    }
 
 
 async def _worker(
@@ -109,6 +180,7 @@ async def run_load(
     try:
         status0 = await clients[0].status()
         h0 = int(status0["sync_info"]["latest_block_height"])
+        metrics0 = await clients[0].metrics_text()  # None when not served
 
         n_workers = max(1, connections) * len(clients)
         interval = n_workers / max(rate, 0.001)
@@ -135,6 +207,7 @@ async def run_load(
 
         status1 = await clients[0].status()
         h1 = int(status1["sync_info"]["latest_block_height"])
+        metrics1 = await clients[0].metrics_text()
         # count only OUR txs (unique "load-<runid>-<n>=" prefix): background
         # traffic AND other load runs' txs must not inflate the committed
         # numbers. Blocks fetched concurrently in chunks (serial per-height
@@ -173,6 +246,7 @@ async def run_load(
             "blocks_per_sec": round((h1 - h0) / (send_wall + settle), 2),
             "committed_txs": committed,
             "committed_tx_s": round(committed / (send_wall + settle), 1),
+            "chain_metrics": _chain_metrics_delta(metrics0, metrics1),
         }
     finally:
         for c in clients:

@@ -40,6 +40,9 @@ def _make_node(tmp_path, port):
     cfg.rpc.laddr = f"tcp://127.0.0.1:{port}"
     cfg.root_dir = ""
     cfg.consensus.wal_path = str(tmp_path / "wal")
+    # serve /metrics so the report's chain_metrics scrape has a source
+    cfg.instrumentation.prometheus = True
+    cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
     priv = FilePV(gen_ed25519(b"\x77" * 32))
     gen = GenesisDoc(
         chain_id="load-chain",
@@ -74,6 +77,12 @@ def test_load_generator_commits_txs(tmp_path):
             # runs are never counted
             assert report["committed_txs"] <= report["sent"], report
             assert len(report["run_id"]) == 8, report
+            # chain-side summary scraped from /metrics over the run window
+            cm = report["chain_metrics"]
+            assert cm is not None, report
+            assert cm["block_intervals_observed"] >= 1, cm
+            assert cm["block_interval_avg_s"] > 0, cm
+            assert cm["step_duration_avg_s"].get("propose") is not None, cm
         finally:
             await node.stop()
 

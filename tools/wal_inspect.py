@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Standalone runner for the WAL post-mortem inspector.
+
+Equivalent to `python -m tendermint_tpu.cli wal-inspect --wal PATH`; the
+implementation (and report format) lives in
+tendermint_tpu/tools/wal_inspect.py. Usage:
+
+    python tools/wal_inspect.py /path/to/data/cs.wal/wal [--limit N]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from tendermint_tpu.tools.wal_inspect import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
