@@ -133,6 +133,18 @@ class ConsensusConfig:
     # TPU batch-verification knobs (no reference counterpart)
     defer_vote_verification: bool = False
     vote_flush_interval: float = 0.05
+    # WAL group-commit (consensus/wal.py): coalesce non-sync WAL writes into
+    # one buffered write per receive-loop queue drain, fsynced when the
+    # oldest un-synced write has aged past wal_group_commit_max_latency
+    # (seconds). write_sync (self-generated messages) still fsyncs before
+    # returning regardless, so consensus SAFETY is unchanged. Trade-off for
+    # peer/timeout frames: vs. the old writer (which never fsynced them but
+    # did land each in the OS page cache per message), group commit adds
+    # machine-crash durability via the aged fsync, while a hard PROCESS
+    # kill mid-drain can lose up to one drain's worth of peer frames from
+    # the replay log (replay completeness, not safety).
+    wal_group_commit: bool = True
+    wal_group_commit_max_latency: float = 0.02
 
     def propose_timeout(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_

@@ -71,12 +71,17 @@ logger = logging.getLogger("tendermint_tpu.consensus")
 
 def commit_to_vote_set(chain_id: str, commit, val_set: ValidatorSet) -> VoteSet:
     """Rebuild the precommit VoteSet from a seen commit
-    (reference: types/vote_set.go CommitToVoteSet)."""
+    (reference: types/vote_set.go CommitToVoteSet). Sign-bytes for the whole
+    commit are built in ONE batched pass (canonical.vote_sign_bytes_many)
+    and seeded into each vote's memo, so the per-vote serial verify inside
+    add_vote never runs the per-row canonical encoder."""
     vote_set = VoteSet(chain_id, commit.height, commit.round, SignedMsgType.PRECOMMIT, val_set)
-    for idx, cs_sig in enumerate(commit.signatures):
-        if cs_sig.absent():
-            continue
-        vote_set.add_vote(commit.get_vote(idx))
+    idxs = [i for i, cs_sig in enumerate(commit.signatures) if not cs_sig.absent()]
+    msgs = commit.vote_sign_bytes_many(chain_id, idxs)
+    for i, msg in zip(idxs, msgs):
+        vote = commit.get_vote(i)
+        vote.seed_sign_bytes(chain_id, msg)
+        vote_set.add_vote(vote)
     return vote_set
 
 
@@ -242,9 +247,15 @@ class ConsensusState:
                 # (queue await + explicit yield) was ~30-50 us/vote under a
                 # vote storm, comparable to the actual bookkeeping. Message
                 # ORDER is exactly the queue order, and each message is still
-                # WAL-written before it is handled, so crash-recovery
-                # semantics are unchanged. Bounded so a firehose peer cannot
-                # starve timers/RPC for more than one batch.
+                # WAL-written before it is handled. With wal_group_commit on,
+                # peer/timeout frames sit in the WAL's in-process buffer until
+                # the drain-end flush below — a hard kill mid-drain can lose
+                # up to one drain's worth of PEER frames from the replay log
+                # (self-generated messages still fsync inline, so safety is
+                # intact; the loss is replay/post-mortem completeness, bounded
+                # by the batch size and the WAL's max-latency fsync bound).
+                # Bounded so a firehose peer cannot starve timers/RPC for
+                # more than one batch.
                 batch = [(kind, payload)]
                 while len(batch) < 512:
                     try:
@@ -270,11 +281,17 @@ class ConsensusState:
                             self._handle_timeout(payload)
                         elif kind == "txs_available":
                             self._handle_txs_available()
-                    # Batch boundary: once the queue drains, flush deferred
-                    # votes in one device batch (storms accumulate while the
-                    # queue is busy, then verify together). Never on quit —
-                    # a shutdown must not batch-verify, commit, or publish
-                    # into components that are already stopping.
+                    # Batch boundary — the group-commit point: everything the
+                    # drain wrote lands as one buffered write, fsynced when
+                    # the max-latency bound is due (no-op when
+                    # wal_group_commit is off or nothing is pending).
+                    if not quit_seen:
+                        self.wal.flush_buffered()
+                    # Then flush deferred votes in one device batch (storms
+                    # accumulate while the queue is busy, then verify
+                    # together). Never on quit — a shutdown must not
+                    # batch-verify, commit, or publish into components that
+                    # are already stopping.
                     if defer and not quit_seen and self._queue.empty():
                         self._flush_deferred_votes()
                 except Exception:
@@ -505,6 +522,16 @@ class ConsensusState:
             self.event_bus.publish_round_state(
                 event_type, self.rs.height, self.rs.round, self.rs.step.name
             )
+
+    def _publish_vote(self, vote: Vote) -> None:
+        self.event_bus.publish_vote(vote)
+
+    def _publish_votes(self, votes: List[Vote]) -> None:
+        """Batch form used by the deferred-vote drain: one subscriber-match
+        pass for the whole batch (EventBus.publish_votes), and — like all
+        vote publishes — free when nobody subscribed to Vote events."""
+        if votes:
+            self.event_bus.publish_votes(votes)
 
     # ------------------------------------------------------------------
     # step: new round (reference: consensus/state.go:907)
@@ -1052,8 +1079,7 @@ class ConsensusState:
                 # Publish only now: enqueue time would advertise (HasVote)
                 # signatures we have not verified, letting a forged vote
                 # suppress gossip of the genuine one.
-                for vote in committed:
-                    self.event_bus.publish_vote(vote)
+                self._publish_votes(committed)
                 if failed:
                     logger.warning(
                         "deferred flush: %d invalid %s signatures at round %d",
@@ -1068,8 +1094,7 @@ class ConsensusState:
                 self._check_progress_after_vote(vtype, vround)
         if rs.last_commit is not None and rs.last_commit.pending_count() > 0:
             committed, _failed = rs.last_commit.flush()
-            for vote in committed:
-                self.event_bus.publish_vote(vote)
+            self._publish_votes(committed)
             for err in rs.last_commit.pop_conflicts():
                 self._handle_vote_conflict(err)
             if self.config.skip_timeout_commit and rs.last_commit.has_all():
@@ -1093,7 +1118,7 @@ class ConsensusState:
                     m.duplicate_votes.inc()
                 return False
             if added != "pending":  # unverified: published at flush instead
-                self.event_bus.publish_vote(vote)
+                self._publish_vote(vote)
             if self.config.skip_timeout_commit and rs.last_commit.has_all():
                 self._enter_new_round(rs.height, 0)
             return True
@@ -1121,7 +1146,7 @@ class ConsensusState:
             # would stop gossiping the genuine vote). flush publishes the
             # ones that verify.
             return True
-        self.event_bus.publish_vote(vote)
+        self._publish_vote(vote)
         self._check_progress_after_vote(vote.type, vote.round)
         return True
 

@@ -314,6 +314,20 @@ _MESSAGE_TYPES = {
 
 
 def encode_message(msg) -> bytes:
+    if type(msg) is VoteMessage:
+        # The envelope memo lives on the VOTE (deeply immutable), not the
+        # per-send VoteMessage wrapper: one vote is wrapped freshly for its
+        # WAL frame and for EVERY peer it is gossiped to, but the bytes are
+        # identical — one build total.
+        vote = msg.vote
+        cached = vote.__dict__.get("_vote_msg_env")
+        if cached is not None:
+            return cached
+        w = pw.Writer()
+        w.message_field(VoteMessage.FIELD, vote.encode(), always=True)
+        data = w.bytes()
+        object.__setattr__(vote, "_vote_msg_env", data)
+        return data
     w = pw.Writer()
     w.message_field(msg.FIELD, msg.encode_body(), always=True)
     return w.bytes()

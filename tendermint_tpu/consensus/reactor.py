@@ -34,6 +34,7 @@ from tendermint_tpu.consensus.messages import (
     encode_message,
 )
 from tendermint_tpu.consensus.round_state import RoundStepType
+from tendermint_tpu.libs import hotstats as _hotstats
 from tendermint_tpu.libs.bits import BitArray
 from tendermint_tpu.p2p.base_reactor import Reactor
 from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
@@ -191,19 +192,29 @@ class PeerState:
     def pick_vote_to_send(self, votes) -> Optional[object]:
         """votes: a VoteSet-like with bit_array()/get_by_index(); returns a
         Vote the peer lacks (reference: PeerState.PickSendVote :1049)."""
+        picked = self.pick_votes_to_send(votes, limit=1)
+        return picked[0] if picked else None
+
+    def pick_votes_to_send(self, votes, limit: int = 64) -> List[object]:
+        """Up to `limit` votes the peer lacks, in index order — ONE pass over
+        the bit arrays per gossip wakeup instead of one full rescan per vote
+        (the per-vote rescan made vote gossip O(validators) per vote)."""
         if votes is None or votes.size() == 0:
-            return None
+            return []
         ours = votes.bit_array()
         height = getattr(votes, "height", self.height)
         round_ = getattr(votes, "round", 0)
         type_ = getattr(votes, "signed_msg_type", SignedMsgType.PREVOTE)
         theirs = self._votes_bits(height, round_, type_, len(ours))
-        if theirs is None:
-            theirs = BitArray(len(ours))
+        out: List[object] = []
         for idx, have in enumerate(ours):
-            if have and not theirs.get_index(idx):
-                return votes.get_by_index(idx)
-        return None
+            if have and (theirs is None or not theirs.get_index(idx)):
+                vote = votes.get_by_index(idx)
+                if vote is not None:
+                    out.append(vote)
+                    if len(out) >= limit:
+                        break
+        return out
 
 
 class ConsensusReactor(Reactor):
@@ -376,6 +387,13 @@ class ConsensusReactor(Reactor):
         )
 
     async def _broadcast_routine(self) -> None:
+        """Event-bus → p2p broadcasts, COALESCED per wakeup: each consume
+        drains everything already queued on its subscription and handles the
+        batch in one call. Under a vote storm that turns N per-vote wakeups
+        (each a full per-peer broadcast round) into one batched
+        `broadcast_many`; for round-step/valid-block events only the LATEST
+        state is broadcast (a NewRoundStepMessage carries full state, so
+        intermediate ones are strictly stale)."""
         bus = self.cs.event_bus
         sub_step = bus.subscribe("cs-reactor", query_for_event(EVENT_NEW_ROUND_STEP), 200)
         sub_valid = bus.subscribe("cs-reactor", query_for_event(EVENT_VALID_BLOCK), 200)
@@ -387,16 +405,30 @@ class ConsensusReactor(Reactor):
                     msg = await sub.next()
                 except Exception:
                     return
+                batch = [msg]
+                done = False
+                while True:
+                    try:
+                        m = sub.queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    if m is None:  # cancellation sentinel (unsubscribed)
+                        done = True
+                        break
+                    batch.append(m)
                 try:
-                    await handler(msg)
+                    await handler(batch)
                 except Exception:
                     logger.exception("broadcast handler failed")
+                if done:
+                    return
 
-        async def on_step(_msg):
+        async def on_steps(_msgs):
+            # coalesced: broadcast our CURRENT round state once per drain
             if self.switch is not None:
                 await self.switch.broadcast(STATE_CHANNEL, encode_message(self._our_round_step()))
 
-        async def on_valid(_msg):
+        async def on_valid(_msgs):
             rs = self.cs.rs
             if self.switch is not None and rs.proposal_block_parts is not None:
                 m = NewValidBlockMessage(
@@ -405,14 +437,26 @@ class ConsensusReactor(Reactor):
                 )
                 await self.switch.broadcast(STATE_CHANNEL, encode_message(m))
 
-        async def on_vote(msg):
-            vote = msg.data.vote
-            if self.switch is not None:
-                m = HasVoteMessage(vote.height, vote.round, vote.type, vote.validator_index)
-                await self.switch.broadcast(STATE_CHANNEL, encode_message(m))
+        async def on_votes(msgs):
+            if self.switch is None:
+                return
+            hs = _hotstats.stats if _hotstats.stats.enabled else None
+            if hs is not None:
+                t0 = _hotstats.perf_counter()
+            payloads = []
+            for msg in msgs:
+                vote = msg.data.vote
+                payloads.append(
+                    encode_message(
+                        HasVoteMessage(vote.height, vote.round, vote.type, vote.validator_index)
+                    )
+                )
+            await self.switch.broadcast_many(STATE_CHANNEL, payloads)
+            if hs is not None:
+                hs.add("gossip", _hotstats.perf_counter() - t0, n=len(msgs))
 
         await asyncio.gather(
-            consume(sub_step, on_step), consume(sub_valid, on_valid), consume(sub_vote, on_vote)
+            consume(sub_step, on_steps), consume(sub_valid, on_valid), consume(sub_vote, on_votes)
         )
 
     # -- gossip routines ----------------------------------------------------
@@ -496,13 +540,19 @@ class ConsensusReactor(Reactor):
             ps.proposal_block_parts.set_index(idx, True)
         return ok
 
+    # max votes sent to one peer per gossip wakeup: one bit-array scan
+    # amortizes over the whole run instead of one rescan per vote, while the
+    # bound keeps a single peer from monopolizing the send queue
+    VOTE_GOSSIP_BATCH = 64
+
     async def _gossip_votes_routine(self, peer, ps: PeerState) -> None:
-        """(reference: consensus/reactor.go:629 gossipVotesRoutine)"""
+        """(reference: consensus/reactor.go:629 gossipVotesRoutine; vote
+        picking is batched — see PeerState.pick_votes_to_send)."""
         try:
             while True:
                 await asyncio.sleep(0)  # guaranteed yield (see data routine)
                 rs = self.cs.rs
-                vote = None
+                picked: List[object] = []
                 if rs.height == ps.height and rs.votes is not None:
                     # current height: prevotes/precommits for peer's round,
                     # POL prevotes, our round's votes
@@ -511,14 +561,17 @@ class ConsensusReactor(Reactor):
                         rs.votes.precommits(ps.round) if ps.round >= 0 else None,
                         rs.votes.prevotes(ps.proposal_pol_round) if ps.proposal_pol_round >= 0 else None,
                     ):
-                        vote = ps.pick_vote_to_send(votes) if votes else None
-                        if vote is not None:
+                        picked = (
+                            ps.pick_votes_to_send(votes, self.VOTE_GOSSIP_BATCH)
+                            if votes else []
+                        )
+                        if picked:
                             break
                 elif (
                     rs.height == ps.height + 1 and rs.last_commit is not None
                 ):
                     # peer is finishing the previous height: send last commit
-                    vote = ps.pick_vote_to_send(rs.last_commit)
+                    picked = ps.pick_votes_to_send(rs.last_commit, self.VOTE_GOSSIP_BATCH)
                 elif (
                     ps.height != 0
                     and rs.height > ps.height + 1
@@ -528,10 +581,19 @@ class ConsensusReactor(Reactor):
                     commit = self.cs.block_store.load_block_commit(ps.height)
                     if commit is not None:
                         vote = self._pick_commit_vote(ps, commit)
-                if vote is not None:
-                    ok = await peer.send(VOTE_CHANNEL, encode_message(VoteMessage(vote)))
-                    if ok:
+                        if vote is not None:
+                            picked = [vote]
+                if picked:
+                    sent_any = False
+                    for vote in picked:
+                        ok = await peer.send(VOTE_CHANNEL, encode_message(VoteMessage(vote)))
+                        if not ok:
+                            break
+                        sent_any = True
+                        # peer-state update coalesces naturally: bits flip as
+                        # sends succeed, so the next scan skips them all
                         ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
+                    if sent_any:
                         continue
                 await asyncio.sleep(GOSSIP_SLEEP)
         except asyncio.CancelledError:

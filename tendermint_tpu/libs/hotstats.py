@@ -1,0 +1,82 @@
+"""Per-stage accumulators for the live vote-path hot loop.
+
+The node's receive loop pays host bookkeeping for every vote across four
+layers — protowire encodes, the WAL, event-bus fan-out and gossip — plus the
+signature verify itself. This module is the shared measuring cup: each layer
+adds its wall time to one of five stage buckets so `bench.py`
+(vote_storm / live_consensus) can report a per-stage µs/vote breakdown in
+`extra` instead of one opaque number, and PERF.md can record which layer a
+regression lives in.
+
+Timing is OFF by default: every instrumented call site reduces to a single
+`stats.enabled` flag check (the same contract as libs/trace.py's hoisted
+tracer). Counts ride along with the times; the redundant-work *counters*
+that must stay cheap enough for production (encode computes, fsyncs) live
+with their subsystems instead (types/vote.py ENCODE_COMPUTES /
+SIGN_BYTES_COMPUTES, consensus/wal.py WAL.fsync_count).
+
+Stages are measured AT THEIR OWN LAYER, so they nest rather than partition:
+a WAL frame write that triggers a first-time Vote.encode counts those
+microseconds under both `wal` and `encode`. The breakdown answers "where is
+time spent per layer", not "what do disjoint slices sum to".
+"""
+
+from __future__ import annotations
+
+from time import perf_counter
+
+__all__ = ["HotpathStats", "stats", "perf_counter"]
+
+
+class HotpathStats:
+    """Five stage buckets: encode (protowire/sign-bytes computes), wal
+    (frame writes + group-commit flushes + fsyncs), pubsub (event-bus
+    publishes), gossip (reactor broadcast fan-out), verify (host or device
+    signature checks)."""
+
+    STAGES = ("encode", "wal", "pubsub", "gossip", "verify")
+
+    __slots__ = ("enabled", "seconds", "counts")
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.reset()
+
+    def reset(self) -> None:
+        self.seconds = {s: 0.0 for s in self.STAGES}
+        self.counts = {s: 0 for s in self.STAGES}
+
+    def add(self, stage: str, dt: float, n: int = 1) -> None:
+        self.seconds[stage] += dt
+        self.counts[stage] += n
+
+    def snapshot(self) -> dict:
+        return {"seconds": dict(self.seconds), "counts": dict(self.counts)}
+
+    def delta_since(self, before: dict) -> dict:
+        """Stage seconds/counts accumulated since a snapshot() — benches
+        bracket a timed region this way so warm-up work is excluded."""
+        return {
+            "seconds": {
+                s: self.seconds[s] - before["seconds"].get(s, 0.0) for s in self.STAGES
+            },
+            "counts": {
+                s: self.counts[s] - before["counts"].get(s, 0) for s in self.STAGES
+            },
+        }
+
+    @staticmethod
+    def breakdown_us(delta: dict, votes: int) -> dict:
+        """{stage}_us per vote from a delta_since() dict — the exact shape
+        bench.py attaches to vote_storm/live_consensus `extra`."""
+        if votes <= 0:
+            return {}
+        return {
+            f"{s}_us": round(delta["seconds"][s] / votes * 1e6, 3)
+            for s in HotpathStats.STAGES
+        }
+
+
+# Process-global instance (one live consensus hot loop per process; benches
+# enable it around their timed regions).
+stats = HotpathStats()

@@ -523,25 +523,46 @@ class BatchVerifyMetrics:
         )
 
 
+class PubSubMetrics:
+    """libs/pubsub.py subscription-buffer health. No reference counterpart —
+    the reference CANCELS a slow subscriber on overflow; here overflow
+    drops-oldest and this counter is how an operator notices."""
+
+    def __init__(self, reg: Registry):
+        self.dropped = reg.counter(
+            f"{NAMESPACE}_pubsub_dropped_messages_total",
+            "Events dropped oldest-first from a slow subscriber's full buffer.",
+            ("subscriber",),
+        )
+
+
 # Process-global registry: series owned by process-global subsystems (the
-# crypto batch pipeline, the AOT kernel cache) rather than a Node instance.
+# crypto batch pipeline, the AOT kernel cache, pubsub overflow accounting)
+# rather than a Node instance.
 _GLOBAL_LOCK = threading.Lock()
 _GLOBAL_REGISTRY: Optional[Registry] = None
 _BATCH_METRICS: Optional[BatchVerifyMetrics] = None
+_PUBSUB_METRICS: Optional[PubSubMetrics] = None
 
 
 def global_registry() -> Registry:
-    global _GLOBAL_REGISTRY, _BATCH_METRICS
+    global _GLOBAL_REGISTRY, _BATCH_METRICS, _PUBSUB_METRICS
     with _GLOBAL_LOCK:
         if _GLOBAL_REGISTRY is None:
             _GLOBAL_REGISTRY = Registry()
             _BATCH_METRICS = BatchVerifyMetrics(_GLOBAL_REGISTRY)
+            _PUBSUB_METRICS = PubSubMetrics(_GLOBAL_REGISTRY)
         return _GLOBAL_REGISTRY
 
 
 def batch_metrics() -> BatchVerifyMetrics:
     global_registry()
     return _BATCH_METRICS
+
+
+def pubsub_metrics() -> PubSubMetrics:
+    global_registry()
+    return _PUBSUB_METRICS
 
 
 class NodeMetrics:

@@ -143,10 +143,17 @@ class Message:
 
 
 class Subscription:
+    """Buffered subscription. Overflow policy: DROP-OLDEST with a counter —
+    a slow subscriber loses its stalest messages (visible on /metrics as
+    `tendermint_pubsub_dropped_messages_total` and on `self.dropped`) but
+    stays subscribed; the old cancel-on-overflow policy turned one slow RPC
+    client into a silent permanent detach."""
+
     def __init__(self, out_capacity: int = 100):
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=out_capacity)
         self.cancelled = False
         self.cancel_reason = ""
+        self.dropped = 0  # messages dropped oldest-first on overflow
 
     async def next(self) -> Message:
         msg = await self.queue.get()
@@ -155,13 +162,42 @@ class Subscription:
         return msg
 
 
-class PubSubServer:
-    """In-process server. publish() is non-blocking: a subscriber whose buffer
-    is full is cancelled (same policy as the reference's non-buffered
-    subscriptions)."""
+# The composite key the subscriber index keys on — same convention as
+# types/event_bus.py EVENT_TYPE_KEY (duplicated here so the generic pubsub
+# layer does not import the typed event layer built on top of it).
+EVENT_TYPE_KEY = "tm.event"
 
-    def __init__(self):
+# trailing per-connection id in subscriber names ('ws-140…', 'btc-9f3a…'):
+# a separator followed by >=4 hex digits, to end of string
+_SUBSCRIBER_ID_SUFFIX = re.compile(r"[-_][0-9a-fA-F]{4,}$")
+
+
+class PubSubServer:
+    """In-process server. publish() is non-blocking (drop-oldest on a full
+    subscriber buffer, see Subscription) and maintains an index of
+    subscriptions by their `tm.event = '<X>'` equality condition so the hot
+    path can skip ALL per-event work when nobody could possibly match —
+    consensus publishes a Vote event per verified vote whether or not
+    anyone is listening, and the zero-subscriber case must cost ~nothing."""
+
+    def __init__(self, index_key: str = EVENT_TYPE_KEY):
         self._subs: Dict[Tuple[str, str], Tuple[Query, Subscription]] = {}
+        self._index_key = index_key
+        # sub key -> indexed event-type value (None = not indexable)
+        self._sub_event_type: Dict[Tuple[str, str], Optional[str]] = {}
+        # event-type value -> sub keys with exactly that equality condition
+        self._by_event_type: Dict[str, set] = {}
+        # sub keys whose query has no single tm.event equality condition
+        # (must be consulted for every publish)
+        self._unindexed: set = set()
+
+    def _index_value(self, query: Query) -> Optional[str]:
+        vals = [
+            c.value
+            for c in query.conditions
+            if c.key == self._index_key and c.op == "=" and c.time_value is None
+        ]
+        return vals[0] if len(vals) == 1 else None
 
     def subscribe(self, subscriber: str, query: Query, out_capacity: int = 100) -> Subscription:
         key = (subscriber, query.query_str)
@@ -169,43 +205,144 @@ class PubSubServer:
             raise ValueError("already subscribed")
         sub = Subscription(out_capacity)
         self._subs[key] = (query, sub)
+        val = self._index_value(query)
+        self._sub_event_type[key] = val
+        if val is None:
+            self._unindexed.add(key)
+        else:
+            self._by_event_type.setdefault(val, set()).add(key)
         return sub
+
+    def _drop_index(self, key: Tuple[str, str]) -> None:
+        val = self._sub_event_type.pop(key, None)
+        if val is None:
+            self._unindexed.discard(key)
+        else:
+            keys = self._by_event_type.get(val)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._by_event_type[val]
+
+    @staticmethod
+    def _cancel(sub: Subscription, reason: str) -> None:
+        sub.cancelled = True
+        sub.cancel_reason = reason
+        try:
+            sub.queue.put_nowait(None)
+        except asyncio.QueueFull:
+            # make room so the cancellation sentinel always lands
+            try:
+                sub.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+            try:
+                sub.queue.put_nowait(None)
+            except asyncio.QueueFull:
+                pass
 
     def unsubscribe(self, subscriber: str, query: Query) -> None:
         key = (subscriber, query.query_str)
         entry = self._subs.pop(key, None)
         if entry is None:
             raise ValueError("subscription not found")
-        _, sub = entry
-        sub.cancelled = True
-        sub.cancel_reason = "unsubscribed"
-        try:
-            sub.queue.put_nowait(None)
-        except asyncio.QueueFull:
-            pass
+        self._drop_index(key)
+        self._cancel(entry[1], "unsubscribed")
 
     def unsubscribe_all(self, subscriber: str) -> None:
         for key in [k for k in self._subs if k[0] == subscriber]:
             _, sub = self._subs.pop(key)
-            sub.cancelled = True
-            sub.cancel_reason = "unsubscribed"
+            self._drop_index(key)
+            self._cancel(sub, "unsubscribed")
+
+    # -- publishing ---------------------------------------------------------
+
+    def has_subscribers(self, event_type: Optional[str] = None) -> bool:
+        """True if a publish for `event_type` could reach anyone. The
+        zero-subscriber fast path: callers check this BEFORE building the
+        event map/payload (types/event_bus.py publish_vote)."""
+        if not self._subs:
+            return False
+        if event_type is None or self._unindexed:
+            return True
+        return event_type in self._by_event_type
+
+    def _candidates(self, events: Dict[str, List[str]]) -> list:
+        """Subscription keys whose indexed condition could match `events`
+        (plus every unindexed one). Deduplicated — an app-emitted attribute
+        can legally collide with the index key (e.g. an ABCI event typed
+        'tm' with key 'event'), putting the same value in the list twice,
+        and a subscriber must still receive each publish exactly once."""
+        keys: dict = {}
+        etvals = events.get(self._index_key)
+        if etvals:
+            for v in etvals:
+                for k in self._by_event_type.get(v, ()):
+                    keys[k] = None
+        for k in self._unindexed:
+            keys[k] = None
+        return list(keys)
+
+    @staticmethod
+    def _metric_label(subscriber: str) -> str:
+        """Stable, bounded-cardinality label for the drop counter: strip
+        per-connection id suffixes ('ws-140…', 'btc-9f3a…') down to their
+        class prefix — every reconnecting websocket must NOT mint a fresh
+        series in the never-pruned global registry."""
+        return _SUBSCRIBER_ID_SUFFIX.sub("", subscriber) or "other"
+
+    def _deliver(self, subscriber: str, sub: Subscription, msg: Message) -> None:
+        try:
+            sub.queue.put_nowait(msg)
+        except asyncio.QueueFull:
+            # Drop-oldest: evict the stalest message, count it, deliver the
+            # new one. Never blocks, never raises, never silently detaches.
             try:
-                sub.queue.put_nowait(None)
+                sub.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+            sub.dropped += 1
+            from tendermint_tpu.libs.metrics import pubsub_metrics
+
+            pubsub_metrics().dropped.labels(self._metric_label(subscriber)).inc()
+            try:
+                sub.queue.put_nowait(msg)
             except asyncio.QueueFull:
                 pass
 
     def publish(self, data: object, events: Dict[str, List[str]]) -> None:
-        for key in list(self._subs.keys()):
-            query, sub = self._subs[key]
+        if not self._subs:
+            return
+        for key in self._candidates(events):
+            entry = self._subs.get(key)
+            if entry is None:
+                continue
+            query, sub = entry
             if not query.matches(events):
                 continue
-            try:
-                sub.queue.put_nowait(Message(data, events))
-            except asyncio.QueueFull:
-                # Slow subscriber: cancel it (reference: pubsub.go send on full)
-                sub.cancelled = True
-                sub.cancel_reason = "client is not pulling messages fast enough"
-                del self._subs[key]
+            self._deliver(key[0], sub, Message(data, events))
+
+    def publish_many(self, datas, events: Dict[str, List[str]]) -> None:
+        """Publish a homogeneous batch: every item in `datas` shares the
+        same `events` map, so subscriber matching runs ONCE for the whole
+        batch instead of once per item (the consensus vote drain publishes
+        hundreds of Vote events per flush)."""
+        if not self._subs or not datas:
+            return
+        matched = []
+        for key in self._candidates(events):
+            entry = self._subs.get(key)
+            if entry is None:
+                continue
+            query, sub = entry
+            if query.matches(events):
+                matched.append((key[0], sub))
+        if not matched:
+            return
+        for data in datas:
+            msg = Message(data, events)
+            for subscriber, sub in matched:
+                self._deliver(subscriber, sub, msg)
 
     def num_clients(self) -> int:
         return len({k[0] for k in self._subs})

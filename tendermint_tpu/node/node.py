@@ -184,7 +184,11 @@ class Node:
             wal_path = os.path.join(config.root_dir, config.consensus.wal_path)
         else:
             wal_path = os.path.join(os.getcwd(), ".tmp_wal", "wal")
-        self.wal = WAL(wal_path)
+        self.wal = WAL(
+            wal_path,
+            group_commit=config.consensus.wal_group_commit,
+            group_commit_max_latency=config.consensus.wal_group_commit_max_latency,
+        )
         self.consensus = ConsensusState(
             config.consensus,
             state,
@@ -229,6 +233,14 @@ class Node:
                 Switch,
             )
 
+            if Switch is None:
+                # the package gates the networked pieces when the
+                # `cryptography` wheel is absent; keep the old loud failure
+                # for nodes that actually configured a p2p listener
+                raise ImportError(
+                    "p2p.laddr is configured but the p2p transport is "
+                    "unavailable (missing `cryptography` wheel)"
+                )
             if config.root_dir:
                 self.node_key = NodeKey.load_or_gen(
                     os.path.join(config.root_dir, "config", "node_key.json")

@@ -6,8 +6,17 @@ from tendermint_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
 from tendermint_tpu.p2p.key import NodeKey, pubkey_to_id
 from tendermint_tpu.p2p.node_info import NodeInfo, parse_addr
 from tendermint_tpu.p2p.peer import Peer, PeerSet
-from tendermint_tpu.p2p.switch import Switch
-from tendermint_tpu.p2p.transport import MultiplexTransport
+
+try:
+    # The wire transport's SecretConnection needs the `cryptography` wheel.
+    # Minimal containers run nodes in-process without p2p — the routing and
+    # reactor types above must stay importable there (consensus/reactor.py
+    # imports this package), so the networked pieces are gated.
+    from tendermint_tpu.p2p.switch import Switch
+    from tendermint_tpu.p2p.transport import MultiplexTransport
+except ImportError:  # pragma: no cover - exercised in minimal containers
+    Switch = None  # type: ignore[assignment]
+    MultiplexTransport = None  # type: ignore[assignment]
 
 __all__ = [
     "ChannelDescriptor",

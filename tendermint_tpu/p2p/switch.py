@@ -273,5 +273,24 @@ class Switch:
             return_exceptions=True,
         )
 
+    async def broadcast_many(self, chan_id: int, msgs: List[bytes]) -> None:
+        """Coalesced broadcast: each peer receives the whole batch in order
+        with ONE task per peer, instead of one gather round per message.
+        Used by the consensus reactor's per-drain HasVote batches."""
+        if not msgs:
+            return
+        if len(msgs) == 1:
+            await self.broadcast(chan_id, msgs[0])
+            return
+
+        async def _send_all(p: Peer) -> None:
+            for m in msgs:
+                await p.send(chan_id, m)
+
+        await asyncio.gather(
+            *(_send_all(p) for p in self.peers.list()),
+            return_exceptions=True,
+        )
+
     def num_peers(self) -> int:
         return self.peers.size()

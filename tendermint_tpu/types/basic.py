@@ -114,11 +114,19 @@ class BlockID:
         return k
 
     def encode(self) -> bytes:
+        # per-instance memo (same idiom as key()): a BlockID is frozen and
+        # every Vote/CommitSig wire encode embeds it — a vote storm shares
+        # one instance across thousands of encodes
+        cached = self.__dict__.get("_wire")
+        if cached is not None:
+            return cached
         w = pw.Writer()
         w.bytes_field(1, self.hash)
         psh = self.part_set_header.encode()
         w.message_field(2, psh, always=True)  # gogo non-nullable
-        return w.bytes()
+        data = w.bytes()
+        object.__setattr__(self, "_wire", data)
+        return data
 
     @classmethod
     def decode(cls, data: bytes) -> "BlockID":

@@ -10,9 +10,19 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 
 from tendermint_tpu.crypto.keys import PubKey
+from tendermint_tpu.libs import hotstats
 from tendermint_tpu.libs import protowire as pw
 from tendermint_tpu.types import canonical
 from tendermint_tpu.types.basic import BlockID, SignedMsgType, ts_seconds_nanos
+
+# Instrumentation: actual protowire/sign-bytes COMPUTES (cache misses), not
+# calls. A Vote is immutable post-construction, so each instance should pay
+# for each at most once no matter how many ingest layers serialize it (WAL
+# frame, gossip re-send, verify). tests/test_hotpath_guard.py budgets these
+# per vote; a new call site that bypasses the memo shows up as a counter
+# regression there, not as a wall-clock flake.
+ENCODE_COMPUTES = 0
+SIGN_BYTES_COMPUTES = 0
 
 
 @dataclass(frozen=True)
@@ -30,9 +40,31 @@ class Vote:
         return self.block_id.is_zero()
 
     def sign_bytes(self, chain_id: str) -> bytes:
-        return canonical.vote_sign_bytes(
+        """Canonical sign-bytes, memoized per instance (a Vote's fields are
+        frozen, so the result can never go stale; dataclasses.replace — e.g.
+        with_signature — builds a NEW instance with an empty cache)."""
+        cached = self.__dict__.get("_sign_bytes")
+        if cached is not None and cached[0] == chain_id:
+            return cached[1]
+        global SIGN_BYTES_COMPUTES
+        SIGN_BYTES_COMPUTES += 1
+        hs = hotstats.stats if hotstats.stats.enabled else None
+        if hs is not None:
+            t0 = hotstats.perf_counter()
+        data = canonical.vote_sign_bytes(
             chain_id, self.type, self.height, self.round, self.block_id, self.timestamp_ns
         )
+        if hs is not None:
+            hs.add("encode", hotstats.perf_counter() - t0)
+        object.__setattr__(self, "_sign_bytes", (chain_id, data))
+        return data
+
+    def seed_sign_bytes(self, chain_id: str, data: bytes) -> None:
+        """Prime the sign-bytes memo from a batched builder
+        (canonical.vote_sign_bytes_many) so a follow-up serial verify does
+        not re-run the per-vote encoder. `data` is length-delimited, exactly
+        what sign_bytes returns."""
+        object.__setattr__(self, "_sign_bytes", (chain_id, data))
 
     def verify(self, chain_id: str, pubkey: PubKey) -> bool:
         """Serial verification (reference: types/vote.go:149). The batched path
@@ -65,20 +97,56 @@ class Vote:
     def with_signature(self, sig: bytes) -> "Vote":
         return replace(self, signature=sig)
 
-    # Wire encoding (proto Vote, fields per types.proto)
+    # Precomputed field tags for the flattened encoder below (byte-identical
+    # to the Writer-built form; pinned by the decode round-trip tests).
+    _T1 = pw.tag(1, pw.VARINT)
+    _T2 = pw.tag(2, pw.VARINT)
+    _T3 = pw.tag(3, pw.VARINT)
+    _T4 = pw.tag(4, pw.BYTES)
+    _T5 = pw.tag(5, pw.BYTES)
+    _T6 = pw.tag(6, pw.BYTES)
+    _T7 = pw.tag(7, pw.VARINT)
+    _T8 = pw.tag(8, pw.BYTES)
+
+    # Wire encoding (proto Vote, fields per types.proto), memoized per
+    # instance: the ingest path serializes the same Vote for the WAL frame
+    # and again for every gossip re-send — immutable post-construction, so
+    # one protowire pass serves them all. Flattened (no Writer objects):
+    # this runs once per vote on the live receive loop.
     def encode(self) -> bytes:
-        w = pw.Writer()
-        w.varint_field(1, int(self.type))
-        w.varint_field(2, self.height)
-        w.varint_field(3, self.round)
+        cached = self.__dict__.get("_wire")
+        if cached is not None:
+            return cached
+        global ENCODE_COMPUTES
+        ENCODE_COMPUTES += 1
+        hs = hotstats.stats if hotstats.stats.enabled else None
+        if hs is not None:
+            t0 = hotstats.perf_counter()
+        enc = pw.encode_varint
+        parts = []
+        t = int(self.type)
+        if t:
+            parts.append(self._T1 + enc(t))
+        if self.height:
+            parts.append(self._T2 + enc(self.height))
+        if self.round:
+            parts.append(self._T3 + enc(self.round))
         bid = self.block_id.encode()
-        w.message_field(4, bid, always=True)
+        parts.append(self._T4 + enc(len(bid)) + bid)
         sec, nanos = ts_seconds_nanos(self.timestamp_ns)
-        w.message_field(5, pw.encode_timestamp(sec, nanos), always=True)
-        w.bytes_field(6, self.validator_address)
-        w.varint_field(7, self.validator_index)
-        w.bytes_field(8, self.signature)
-        return w.bytes()
+        ts = pw.encode_timestamp(sec, nanos)
+        parts.append(self._T5 + enc(len(ts)) + ts)
+        if self.validator_address:
+            parts.append(self._T6 + enc(len(self.validator_address)) + self.validator_address)
+        if self.validator_index:
+            parts.append(self._T7 + enc(self.validator_index))
+        if self.signature:
+            parts.append(self._T8 + enc(len(self.signature)) + self.signature)
+        data = b"".join(parts)
+        if hs is not None:
+            hs.add("encode", hotstats.perf_counter() - t0)
+        object.__setattr__(self, "_wire", data)
+        return data
 
     @classmethod
     def decode(cls, data: bytes) -> "Vote":

@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
 from tendermint_tpu.crypto.batch import verify_batch
+from tendermint_tpu.libs import hotstats
 from tendermint_tpu.types.basic import BlockID, SignedMsgType
 from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.types.vote import Vote
@@ -183,18 +184,27 @@ class VoteSet:
             if seen_key in self._pending_seen:
                 return False
             self._pending_seen.add(seen_key)
-            self._pending.append((idx, vote))
+            # carry the resolved Validator so flush() skips a second
+            # get_by_index per vote
+            self._pending.append((idx, vote, val))
             return "pending"
 
         if not self._verify_now(vote, val.pub_key):
             raise VoteSetError(f"invalid signature from validator {idx}")
-        added, conflicting = self._add_verified(idx, vote, val.voting_power)
+        added, conflicting = self._add_verified(idx, vote, val.voting_power, block_key)
         if conflicting is not None:
             raise ConflictingVotesError(conflicting, vote)
         return added
 
     def _verify_now(self, vote: Vote, pub_key) -> bool:
-        return pub_key.verify(vote.sign_bytes(self.chain_id), vote.signature)
+        hs = hotstats.stats if hotstats.stats.enabled else None
+        if hs is None:
+            return pub_key.verify(vote.sign_bytes(self.chain_id), vote.signature)
+        msg = vote.sign_bytes(self.chain_id)  # counted under "encode" by the memo
+        t0 = hotstats.perf_counter()
+        ok = pub_key.verify(msg, vote.signature)
+        hs.add("verify", hotstats.perf_counter() - t0)
+        return ok
 
     def flush(self) -> Tuple[List[Vote], List[int]]:
         """Batch-verify all deferred votes in one device call; commits the
@@ -207,8 +217,7 @@ class VoteSet:
         from tendermint_tpu.types import canonical
 
         pubkeys, sigs, key_types = [], [], []
-        for idx, vote in self._pending:
-            _, val = self.val_set.get_by_index(idx)
+        for _idx, vote, val in self._pending:
             pubkeys.append(val.pub_key.bytes())
             sigs.append(vote.signature)
             key_types.append(val.pub_key.type_name())
@@ -219,24 +228,29 @@ class VoteSet:
             self.signed_msg_type,
             self.height,
             self.round,
-            ((vote.block_id, vote.timestamp_ns) for _, vote in self._pending),
+            ((vote.block_id, vote.timestamp_ns) for _, vote, _ in self._pending),
         )
         # key_types matters: in a mixed validator set an sr25519 vote
         # verified under ed25519 rules always fails (marker bit forces
         # s >= L) — dropping valid votes on the deferred path would be a
         # liveness break (mirrors validator_set.py batched Verify*).
+        hs = hotstats.stats if hotstats.stats.enabled else None
+        if hs is not None:
+            t0 = hotstats.perf_counter()
         mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
+        if hs is not None:
+            hs.add("verify", hotstats.perf_counter() - t0, n=len(pubkeys))
         committed = []
         failed = []
-        for ok, (idx, vote) in zip(mask, self._pending):
+        for ok, (idx, vote, val) in zip(mask, self._pending):
             if not ok:
                 failed.append(idx)
                 continue
-            _, val = self.val_set.get_by_index(idx)
+            block_key = vote.block_id.key()
             # Re-check: an earlier pending vote may have committed already.
-            if self._get_vote(idx, vote.block_id.key()) is not None:
+            if self._get_vote(idx, block_key) is not None:
                 continue
-            added, conflicting = self._add_verified(idx, vote, val.voting_power)
+            added, conflicting = self._add_verified(idx, vote, val.voting_power, block_key)
             if added:
                 committed.append(vote)
             if conflicting is not None:
@@ -246,11 +260,14 @@ class VoteSet:
         return committed, failed
 
     def _add_verified(
-        self, idx: int, vote: Vote, power: int
+        self, idx: int, vote: Vote, power: int, block_key: Optional[bytes] = None
     ) -> Tuple[bool, Optional[Vote]]:
         """Mirror of reference addVerifiedVote (types/vote_set.go:229-290).
-        Assumes the signature is already verified."""
-        block_key = vote.block_id.key()
+        Assumes the signature is already verified. `block_key` is accepted
+        from callers that already computed it (the add path computes it for
+        duplicate detection; recomputing here was measurable under storms)."""
+        if block_key is None:
+            block_key = vote.block_id.key()
         conflicting: Optional[Vote] = None
 
         existing = self._votes[idx]

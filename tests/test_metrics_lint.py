@@ -155,6 +155,7 @@ METRICS_SETS = (
     M.BlockSyncMetrics,
     M.StateSyncMetrics,
     M.BatchVerifyMetrics,
+    M.PubSubMetrics,
 )
 
 

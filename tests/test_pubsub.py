@@ -65,7 +65,12 @@ def test_query_time_rejects_bad_literals():
         Query("a.b = DATE 2013-13-90")
 
 
-def test_pubsub_publish_and_slow_subscriber_cancel():
+def test_pubsub_publish_and_slow_subscriber_drops_oldest():
+    """Overflow policy: drop-oldest with a counter, NOT cancel — a slow
+    subscriber loses stale messages but stays subscribed, and the drops are
+    visible on sub.dropped and the process-global /metrics counter."""
+    from tendermint_tpu.libs.metrics import pubsub_metrics
+
     async def run():
         srv = PubSubServer()
         sub = srv.subscribe("s1", Query("tm.event = 'Tx'"), out_capacity=2)
@@ -73,10 +78,118 @@ def test_pubsub_publish_and_slow_subscriber_cancel():
         srv.publish("ignored", {"tm.event": ["NewBlock"]})
         m = await sub.next()
         assert m.data == "d1"
-        # overflow cancels the subscriber (reference: pubsub.go full-buffer policy)
-        for _ in range(4):
-            srv.publish("x", {"tm.event": ["Tx"]})
+        before = pubsub_metrics().dropped._values.get(("s1",), 0.0)
+        for i in range(4):
+            srv.publish(f"x{i}", {"tm.event": ["Tx"]})
+        # still subscribed, newest messages retained, oldest dropped
+        assert not sub.cancelled
+        assert srv.num_client_subscriptions("s1") == 1
+        assert sub.dropped == 2
+        assert pubsub_metrics().dropped._values[("s1",)] == before + 2
+        assert (await sub.next()).data == "x2"
+        assert (await sub.next()).data == "x3"
+
+    asyncio.run(run())
+
+
+def test_pubsub_drop_counter_on_metrics_exposition():
+    """Satellite: the drop counter is surfaced on the /metrics exposition
+    (NodeMetrics.expose appends the process-global registry)."""
+    from tendermint_tpu.libs.metrics import NodeMetrics
+
+    async def run():
+        srv = PubSubServer()
+        srv.subscribe("slowpoke", Query("tm.event = 'Tx'"), out_capacity=1)
+        for i in range(3):
+            srv.publish(f"d{i}", {"tm.event": ["Tx"]})
+
+    asyncio.run(run())
+    text = NodeMetrics().expose()
+    assert "tendermint_pubsub_dropped_messages_total" in text
+    assert 'subscriber="slowpoke"' in text
+
+
+def test_pubsub_zero_subscriber_fast_path_and_index():
+    async def run():
+        srv = PubSubServer()
+        assert not srv.has_subscribers()
+        assert not srv.has_subscribers("Vote")
+        sub = srv.subscribe("s1", Query("tm.event = 'Vote'"))
+        assert srv.has_subscribers()
+        assert srv.has_subscribers("Vote")
+        assert not srv.has_subscribers("Tx")  # indexed: only Vote could match
+        # a non-indexable query (no tm.event equality) forces the slow path
+        srv.subscribe("s2", Query("account.balance > 5"))
+        assert srv.has_subscribers("Tx")
+        srv.unsubscribe_all("s2")
+        assert not srv.has_subscribers("Tx")
+        # indexed delivery still works end-to-end
+        srv.publish("v", {"tm.event": ["Vote"]})
+        assert (await sub.next()).data == "v"
+        srv.unsubscribe_all("s1")
+        assert not srv.has_subscribers()
+
+    asyncio.run(run())
+
+
+def test_pubsub_publish_many_matches_once_and_delivers_all():
+    async def run():
+        srv = PubSubServer()
+        sub = srv.subscribe("s1", Query("tm.event = 'Vote'"), out_capacity=10)
+        other = srv.subscribe("s2", Query("tm.event = 'Tx'"), out_capacity=10)
+        srv.publish_many(["a", "b", "c"], {"tm.event": ["Vote"]})
+        got = [(await sub.next()).data for _ in range(3)]
+        assert got == ["a", "b", "c"]
+        assert other.queue.qsize() == 0
+        # batch overflow also drops oldest
+        srv.publish_many([f"x{i}" for i in range(12)], {"tm.event": ["Vote"]})
+        assert sub.dropped == 2
+        assert (await sub.next()).data == "x2"
+
+    asyncio.run(run())
+
+
+def test_pubsub_duplicate_event_type_values_deliver_once():
+    """An ABCI app can legally emit an attribute that collides with
+    tm.event, duplicating the value in the composite map — each publish
+    must still reach a subscriber exactly once."""
+
+    async def run():
+        srv = PubSubServer()
+        sub = srv.subscribe("s1", Query("tm.event = 'NewBlock'"), out_capacity=10)
+        srv.publish("blk", {"tm.event": ["NewBlock", "NewBlock"]})
+        assert (await sub.next()).data == "blk"
+        assert sub.queue.qsize() == 0  # not delivered twice
+        srv.publish_many(["a", "b"], {"tm.event": ["NewBlock", "NewBlock"]})
+        assert [(await sub.next()).data for _ in range(2)] == ["a", "b"]
+        assert sub.queue.qsize() == 0
+
+    asyncio.run(run())
+
+
+def test_pubsub_drop_label_has_bounded_cardinality():
+    """Per-connection subscriber ids ('ws-<id()>', 'btc-<txhash>') must not
+    mint one metrics series each — the drop counter labels by the stable
+    class prefix."""
+    assert PubSubServer._metric_label("ws-140234567890") == "ws"
+    assert PubSubServer._metric_label("btc-9f3aab12cdef3456") == "btc"
+    assert PubSubServer._metric_label("cs-reactor") == "cs-reactor"
+    assert PubSubServer._metric_label("verify-slowpoke") == "verify-slowpoke"
+    assert PubSubServer._metric_label("1234") == "1234"  # no separator: kept
+
+
+def test_pubsub_unsubscribe_lands_sentinel_even_when_full():
+    """Cancellation must surface even on a full buffer: the sentinel evicts
+    an old message instead of being silently discarded."""
+    import pytest
+
+    async def run():
+        srv = PubSubServer()
+        sub = srv.subscribe("s1", Query("tm.event = 'Tx'"), out_capacity=1)
+        srv.publish("d0", {"tm.event": ["Tx"]})
+        srv.unsubscribe("s1", Query("tm.event = 'Tx'"))
         assert sub.cancelled
-        assert srv.num_client_subscriptions("s1") == 0
+        with pytest.raises(RuntimeError):
+            await sub.next()
 
     asyncio.run(run())

@@ -1,0 +1,131 @@
+"""Vote encode/sign-bytes memoization (types/vote.py).
+
+A Vote is immutable post-construction, so its protowire encoding and
+canonical sign-bytes can be computed at most once per instance no matter how
+many ingest layers serialize it (WAL frame, gossip re-send, verify). The
+instrumented counters ENCODE_COMPUTES / SIGN_BYTES_COMPUTES count actual
+cache misses; these tests pin (a) at-most-once per ingest path and (b) that
+a derived ("mutated") Vote NEVER serves the original's stale cache.
+"""
+
+import dataclasses
+import time
+
+from tendermint_tpu.consensus.messages import VoteMessage, encode_message
+from tendermint_tpu.consensus.wal import WAL, MsgInfo
+from tendermint_tpu.crypto import gen_ed25519
+from tendermint_tpu.types import canonical
+from tendermint_tpu.types import vote as vote_mod
+from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+from tendermint_tpu.types.vote import Vote
+
+BID = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+
+
+def make_vote(**overrides) -> Vote:
+    kw = dict(
+        type=SignedMsgType.PREVOTE,
+        height=7,
+        round=0,
+        block_id=BID,
+        timestamp_ns=1_700_000_000_000_000_000,
+        validator_address=b"\x0a" * 20,
+        validator_index=3,
+        signature=b"\x55" * 64,
+    )
+    kw.update(overrides)
+    return Vote(**kw)
+
+
+def test_encode_computed_at_most_once_per_ingest_path(tmp_path):
+    """The live ingest path serializes one gossiped vote for the WAL frame
+    and again for each gossip re-send: the protowire encoder must run ONCE."""
+    vote = Vote.decode(make_vote().encode())  # arrives off the wire
+    before = vote_mod.ENCODE_COMPUTES
+    wal = WAL(str(tmp_path / "wal"), group_commit=True)
+    wal.write(MsgInfo(VoteMessage(vote), "peer-1"))        # WAL frame
+    gossip_1 = encode_message(VoteMessage(vote))           # re-send to peer A
+    gossip_2 = encode_message(VoteMessage(vote))           # re-send to peer B
+    wal.flush_buffered()
+    wal.close()
+    assert vote_mod.ENCODE_COMPUTES - before == 1
+    assert gossip_1 == gossip_2
+    # and the WAL replay round-trips the identical vote
+    got = [m for m in WAL(str(tmp_path / "wal")).iter_messages() if isinstance(m, MsgInfo)]
+    assert got[0].msg.vote == vote
+
+
+def test_memoized_encode_is_byte_identical_to_fresh_instance():
+    v = make_vote()
+    first = v.encode()
+    assert v.encode() is first  # cache hit returns the same object
+    fresh = dataclasses.replace(v)  # new instance, empty cache
+    assert fresh.encode() == first
+    assert Vote.decode(first) == v
+
+
+def test_derived_vote_never_serves_stale_cache():
+    """'Mutating' a frozen Vote means dataclasses.replace/with_signature —
+    the derived instance must re-encode, not inherit the original's bytes."""
+    v = make_vote()
+    _ = v.encode()
+    _ = v.sign_bytes("chain-a")
+    for changed in (
+        v.with_signature(b"\x66" * 64),
+        dataclasses.replace(v, round=5),
+        dataclasses.replace(v, height=8),
+        dataclasses.replace(v, timestamp_ns=v.timestamp_ns + 1),
+        dataclasses.replace(v, block_id=BlockID()),
+    ):
+        assert changed.encode() != v.encode()
+        assert Vote.decode(changed.encode()) == changed
+        if changed.height == v.height and changed.round == v.round:
+            # signature is not part of sign-bytes; the others must differ
+            if changed.timestamp_ns == v.timestamp_ns and changed.block_id == v.block_id:
+                assert changed.sign_bytes("chain-a") == v.sign_bytes("chain-a")
+            else:
+                assert changed.sign_bytes("chain-a") != v.sign_bytes("chain-a")
+
+
+def test_sign_bytes_memo_respects_chain_id():
+    v = make_vote()
+    before = vote_mod.SIGN_BYTES_COMPUTES
+    a1 = v.sign_bytes("chain-a")
+    a2 = v.sign_bytes("chain-a")
+    assert a2 is a1
+    assert vote_mod.SIGN_BYTES_COMPUTES - before == 1
+    b = v.sign_bytes("chain-b")  # different chain: recompute, not stale serve
+    assert b != a1
+    assert vote_mod.SIGN_BYTES_COMPUTES - before == 2
+    # byte-identical to the unmemoized canonical builder
+    assert a1 == canonical.vote_sign_bytes(
+        "chain-a", v.type, v.height, v.round, v.block_id, v.timestamp_ns
+    )
+
+
+def test_seed_sign_bytes_primes_the_memo():
+    """commit_to_vote_set seeds per-vote sign-bytes from the batched builder;
+    the seeded value must be exactly what sign_bytes would compute."""
+    v = make_vote()
+    expected = canonical.vote_sign_bytes(
+        "seed-chain", v.type, v.height, v.round, v.block_id, v.timestamp_ns
+    )
+    [row] = canonical.vote_sign_bytes_many(
+        "seed-chain", v.type, v.height, v.round, [(v.block_id, v.timestamp_ns)]
+    )
+    assert row == expected
+    before = vote_mod.SIGN_BYTES_COMPUTES
+    v.seed_sign_bytes("seed-chain", row)
+    assert v.sign_bytes("seed-chain") is row
+    assert vote_mod.SIGN_BYTES_COMPUTES == before  # no compute happened
+
+
+def test_serial_verify_uses_memo_once():
+    priv = gen_ed25519(b"\x42" * 32)
+    unsigned = make_vote(validator_address=priv.pub_key().address(), signature=b"")
+    sig = priv.sign(unsigned.sign_bytes("memo-chain"))
+    vote = unsigned.with_signature(sig)
+    before = vote_mod.SIGN_BYTES_COMPUTES
+    assert vote.verify("memo-chain", priv.pub_key())
+    assert vote.verify("memo-chain", priv.pub_key())  # re-verify: cache hit
+    assert vote_mod.SIGN_BYTES_COMPUTES - before == 1

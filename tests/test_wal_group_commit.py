@@ -1,0 +1,228 @@
+"""WAL group-commit (consensus/wal.py): coalesced write+fsync per queue
+drain with UNCHANGED crash-recovery semantics.
+
+The contract under test (ISSUE 3 tentpole part 1):
+- `write()` buffers; `flush_buffered()` lands the whole batch as one file
+  write + one fsync;
+- `write_sync()` (self-generated messages) flushes buffered frames first —
+  exact ordering — and fsyncs BEFORE returning;
+- killing the writer mid-batch loses at most the un-synced suffix: replay
+  yields a clean prefix, never a torn or duplicated message, and
+  `write_end_height` ordering/anchoring survives;
+- the byte stream is identical to the non-batched writer's.
+"""
+
+import os
+import struct
+
+import pytest
+
+from tendermint_tpu.consensus.messages import HasVoteMessage
+from tendermint_tpu.consensus.wal import (
+    WAL,
+    EndHeightMessage,
+    EventRoundState,
+    MsgInfo,
+    TimeoutInfo,
+    iter_wal_messages,
+)
+
+
+def sample_msgs(height: int, n: int = 8):
+    out = []
+    for r in range(n):
+        out.append(EventRoundState(height, r, 1))
+        out.append(MsgInfo(HasVoteMessage(height, r, 1, r % 5), peer_id=f"p{r}"))
+        out.append(TimeoutInfo(0.5, height, r, 2))
+    return out
+
+
+def test_group_commit_one_write_one_aged_fsync_per_drain(tmp_path):
+    import time as _time
+
+    wal = WAL(str(tmp_path / "wal"), group_commit=True, group_commit_max_latency=60.0)
+    base_fsyncs = wal.fsync_count  # constructor's EndHeight(0) anchor
+    msgs = sample_msgs(1, n=64)
+    for m in msgs:
+        wal.write(m)
+    # nothing flushed yet: the on-disk group holds only the anchor
+    assert list(iter_wal_messages(wal.path)) == [EndHeightMessage(0)]
+    assert wal.fsync_count == base_fsyncs
+    # a drain lands ONE buffered write; young data does not fsync yet
+    wal.flush_buffered()
+    assert wal.fsync_count == base_fsyncs
+    assert list(iter_wal_messages(wal.path)) == [EndHeightMessage(0)] + msgs
+    # age the un-synced data past the bound: the next drain fsyncs ONCE
+    wal._dirty_since = _time.perf_counter() - 999.0
+    wal.flush_buffered()
+    assert wal.fsync_count == base_fsyncs + 1
+    wal.flush_buffered()  # nothing pending: no-op, no extra fsync
+    assert wal.fsync_count == base_fsyncs + 1
+    wal.close()
+
+
+def test_write_sync_flushes_buffer_first_and_fsyncs_before_return(tmp_path, monkeypatch):
+    """Monkeypatched os.fsync ordering proof: at the moment write_sync's
+    fsync fires, the file already contains every buffered frame AND the
+    sync-written message, in order — group commit never acks a
+    self-generated message before its fsync."""
+    path = str(tmp_path / "wal")
+    wal = WAL(path, group_commit=True, group_commit_max_latency=60.0)
+
+    seen_at_fsync = []
+    real_fsync = os.fsync
+
+    def recording_fsync(fd):
+        real_fsync(fd)
+        seen_at_fsync.append(list(iter_wal_messages(path)))
+
+    monkeypatch.setattr(os, "fsync", recording_fsync)
+
+    peer_msgs = sample_msgs(1, n=4)
+    for m in peer_msgs:
+        wal.write(m)
+    internal = MsgInfo(HasVoteMessage(1, 0, 2, 3), peer_id="")
+    wal.write_sync(internal)
+    # exactly one fsync for buffer + sync message together
+    assert len(seen_at_fsync) == 1
+    assert seen_at_fsync[0] == [EndHeightMessage(0)] + peer_msgs + [internal]
+    # and write_end_height (the commit marker) also fsyncs before returning
+    wal.write(EventRoundState(2, 0, 1))
+    wal.write_end_height(1)
+    assert seen_at_fsync[-1] == (
+        [EndHeightMessage(0)] + peer_msgs + [internal]
+        + [EventRoundState(2, 0, 1), EndHeightMessage(1)]
+    )
+    wal.close()
+
+
+def test_kill_writer_mid_batch_loses_only_unsynced_suffix(tmp_path):
+    """Simulated crash: buffered frames that never hit flush are gone, but
+    replay sees a clean prefix ending at the last synced point — no torn
+    frame, no duplicate, EndHeight ordering intact."""
+    path = str(tmp_path / "wal")
+    wal = WAL(path, group_commit=True, group_commit_max_latency=60.0)
+    durable = [EndHeightMessage(0)]
+    for h in (1, 2):
+        msgs = sample_msgs(h)
+        for m in msgs:
+            wal.write(m)
+        wal.write_end_height(h)  # syncs the batch + the marker
+        durable += msgs + [EndHeightMessage(h)]
+    # height 3: a batch is buffered but the process dies before any flush
+    for m in sample_msgs(3):
+        wal.write(m)
+    del wal  # simulate kill: buffered frames are never written
+
+    wal2 = WAL(path, group_commit=True)
+    got = list(wal2.iter_messages(strict=True))  # strict: no torn frame at all
+    assert got == durable
+    # catchup replay finds the last completed height and nothing beyond it
+    assert wal2.search_for_end_height(2) == []
+    assert wal2.search_for_end_height(3) is None
+    wal2.close()
+
+
+def test_torn_flush_replays_clean_prefix(tmp_path):
+    """A crash MID-flush tears at a frame boundary at worst: truncate the
+    file inside the last batch's bytes at every offset; non-strict replay
+    must always yield a prefix of what was written (wal_repair semantics,
+    re-proven for the batched writer)."""
+    path = str(tmp_path / "wal")
+    wal = WAL(path, group_commit=True, group_commit_max_latency=60.0)
+    written = [EndHeightMessage(0)]
+    for m in sample_msgs(1):
+        wal.write(m)
+        written.append(m)
+    wal.flush_buffered()
+    wal.close()
+    blob = (tmp_path / "wal").read_bytes()
+    bounds = []
+    pos = 0
+    while pos < len(blob):
+        _, length = struct.unpack_from(">II", blob, pos)
+        pos += 8 + length
+        bounds.append(pos)
+    start = bounds[-4]
+    for cut in range(start, len(blob)):
+        (tmp_path / "wal").write_bytes(blob[:cut])
+        got = list(iter_wal_messages(path))
+        n_complete = sum(1 for b in bounds if b <= cut)
+        assert got == written[:n_complete], f"cut={cut}"
+    (tmp_path / "wal").write_bytes(blob)
+
+
+def test_group_commit_stream_byte_identical_to_serial_writer(tmp_path):
+    msgs = []
+    for h in (1, 2, 3):
+        msgs += sample_msgs(h) + [EndHeightMessage(h)]
+
+    def write_all(path, group):
+        wal = WAL(str(path), group_commit=group)
+        for m in msgs:
+            if isinstance(m, EndHeightMessage):
+                wal.write_end_height(m.height)
+            else:
+                wal.write(m)
+        wal.close()
+        return path.read_bytes()
+
+    assert write_all(tmp_path / "a", True) == write_all(tmp_path / "b", False)
+
+
+def test_max_latency_bound_forces_inline_flush(tmp_path):
+    """Aged un-synced data flushes+fsyncs inline on the next write — a
+    trickle of peer messages can never sit un-synced past the bound."""
+    wal = WAL(str(tmp_path / "wal"), group_commit=True, group_commit_max_latency=0.0)
+    base = wal.fsync_count
+    wal.write(EventRoundState(1, 0, 1))  # starts the dirty clock
+    wal.write(EventRoundState(1, 0, 2))  # aged past 0.0 -> inline flush+fsync
+    assert wal.fsync_count > base
+    assert EventRoundState(1, 0, 1) in list(iter_wal_messages(wal.path))
+    wal.close()
+
+
+def test_group_commit_rotation_preserves_messages(tmp_path):
+    """Rotation still happens (checked at flush boundaries) and no message
+    is lost across rotated files."""
+    path = str(tmp_path / "wal")
+    wal = WAL(path, head_size_limit=512, group_commit=True, group_commit_max_latency=60.0)
+    written = [EndHeightMessage(0)]
+    for h in range(1, 8):
+        for m in sample_msgs(h, n=4):
+            wal.write(m)
+            written.append(m)
+        wal.write_end_height(h)
+        written.append(EndHeightMessage(h))
+    wal.close()
+    assert os.path.exists(path + ".000")  # rotated at least once
+    wal2 = WAL(path, group_commit=True)
+    assert list(wal2.iter_messages(strict=True)) == written
+    wal2.close()
+
+
+def test_iter_messages_sees_buffered_frames(tmp_path):
+    """A live WAL's own reads (catchup replay) must include frames still in
+    the group-commit buffer."""
+    wal = WAL(str(tmp_path / "wal"), group_commit=True, group_commit_max_latency=60.0)
+    wal.write(EventRoundState(1, 0, 1))
+    assert EventRoundState(1, 0, 1) in list(wal.iter_messages())
+    wal.close()
+
+
+@pytest.mark.parametrize("group", [False, True])
+def test_node_crash_semantics_preserved_via_catchup(tmp_path, group):
+    """search_for_end_height behaves identically in both modes after a
+    clean close (the crash matrix in test_crash_recovery.py exercises the
+    hard-kill path through a full node)."""
+    path = str(tmp_path / f"wal-{group}")
+    wal = WAL(path, group_commit=group)
+    for h in (1, 2):
+        for m in sample_msgs(h, n=2):
+            wal.write(m)
+        wal.write_end_height(h)
+    wal.write(EventRoundState(3, 0, 1))
+    wal.close()
+    wal2 = WAL(path, group_commit=group)
+    assert wal2.search_for_end_height(2) == [EventRoundState(3, 0, 1)]
+    wal2.close()
