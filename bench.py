@@ -27,6 +27,15 @@ Sub-benchmarks (in "extra", budget permitting):
   fastsync_replay     — blocks x validators batched replay (config 4)
   mixed_streaming     — ed25519+sr25519 mixed 10k set (config 5)
   streaming_{n}_sigs_per_sec — sustained sigs/s, pipelined RLC batches
+  chaos_recovery      — the robustness scenario (docs/ROBUSTNESS.md): a
+                        chaos-injected persistent device failure drives the
+                        verify-path circuit breaker; reports
+                        flushes_to_trip (should equal the threshold),
+                        trip_latency_ms (first failure -> breaker OPEN),
+                        open_flush_ms vs closed_flush_ms (the degraded
+                        CPU flush cost; open flushes must not touch the
+                        device — device_calls_while_open is asserted 0),
+                        and rearm_ms (heal -> passing probe -> TPU again)
 
 Flight-recorder breakdown (always in "extra", including the stall fallback):
   verify_stats  — per-stage pipeline telemetry from libs/trace.py:
@@ -728,6 +737,71 @@ def bench_mixed_streaming(n: int = 10000, sr_frac: float = 0.2):
 import contextlib
 
 
+def bench_chaos_recovery(n: int = 512):
+    """Chaos scenario: persistent injected device failure -> circuit breaker
+    trips -> sticky CPU flushes (no device retries) -> heal -> probe re-arms
+    the TPU path. Reports the recovery latencies a production operator cares
+    about. Cheap by construction: the injector raises at the device ENTRY
+    points, so no kernel runs while faulted."""
+    from tendermint_tpu.chaos.device import DeviceFaultInjector
+    from tendermint_tpu.crypto import batch
+    from tendermint_tpu.crypto.circuit_breaker import VerifyCircuitBreaker
+
+    pubkeys, msgs, sigs, _types = make_batch(n)
+    orig_breaker = batch.BREAKER
+    inj = DeviceFaultInjector().install()
+    try:
+        batch.BREAKER = VerifyCircuitBreaker(
+            probe=batch._breaker_probe,
+            failure_threshold=3,
+            spawn_probe_thread=False,  # re-arm timed explicitly below
+        )
+        # healthy baseline flush (first call may compile; time the second)
+        batch.verify_batch(pubkeys, msgs, sigs, backend="jax")
+        t0 = time.perf_counter()
+        batch.verify_batch(pubkeys, msgs, sigs, backend="jax")
+        closed_flush_ms = (time.perf_counter() - t0) * 1e3
+
+        # persistent failure: count flushes until the breaker opens
+        inj.set_persistent(True)
+        flushes_to_trip = 0
+        t0 = time.perf_counter()
+        while batch.BREAKER.allow_device():
+            batch.verify_batch(pubkeys, msgs, sigs, backend="jax")
+            flushes_to_trip += 1
+            if flushes_to_trip > 50:
+                raise RuntimeError("breaker never tripped")
+        trip_latency_ms = (time.perf_counter() - t0) * 1e3
+
+        # OPEN: degraded flushes must be pure CPU (zero device entries)
+        calls_at_open = inj.calls
+        t0 = time.perf_counter()
+        batch.verify_batch(pubkeys, msgs, sigs, backend="jax")
+        open_flush_ms = (time.perf_counter() - t0) * 1e3
+        device_calls_while_open = inj.calls - calls_at_open
+
+        # heal -> probe -> TPU path restored
+        inj.heal()
+        t0 = time.perf_counter()
+        probe_ok = batch.BREAKER.probe_now()
+        rearm_ms = (time.perf_counter() - t0) * 1e3
+        snap = batch.BREAKER.snapshot()
+        return {
+            "n": n,
+            "closed_flush_ms": round(closed_flush_ms, 3),
+            "flushes_to_trip": flushes_to_trip,
+            "trip_latency_ms": round(trip_latency_ms, 3),
+            "open_flush_ms": round(open_flush_ms, 3),
+            "device_calls_while_open": device_calls_while_open,
+            "probe_ok": bool(probe_ok),
+            "rearm_ms": round(rearm_ms, 3),
+            "trips": snap["trips"],
+        }
+    finally:
+        inj.uninstall()
+        batch.BREAKER = orig_breaker
+
+
 @contextlib.contextmanager
 def watchdog(seconds: float):
     """Abort a stage if it stalls: the device tunnel has been observed to
@@ -877,6 +951,20 @@ def main():
             )
         except Exception as e:
             log(f"[vote_storm] FAILED: {e}")
+
+    if head is not None and remaining() > 90:
+        try:
+            with watchdog(max(60.0, remaining() - 60.0)):
+                cr = bench_chaos_recovery()
+            extra["chaos_recovery"] = cr
+            log(
+                f"[chaos_recovery] trip after {cr['flushes_to_trip']} flushes "
+                f"({cr['trip_latency_ms']:.1f} ms), open flush "
+                f"{cr['open_flush_ms']:.1f} ms (device calls while open: "
+                f"{cr['device_calls_while_open']}), re-arm {cr['rearm_ms']:.1f} ms"
+            )
+        except Exception as e:
+            log(f"[chaos_recovery] FAILED: {e}")
 
     if head is not None and remaining() > 240:
         try:

@@ -1,0 +1,30 @@
+"""Chaos engine: seeded, deterministic fault injection for the full stack.
+
+Three fault levels, matching where a production validator actually breaks:
+
+- **device** (chaos/device.py): the batch-verify entry points in
+  crypto/batch.py raise or hang on schedule, exercising the degradation
+  ladder (RLC -> per-sig -> CPU) and the verify-path circuit breaker;
+- **network** (chaos/harness.py + p2p/switch.py conn filters,
+  p2p/fuzz.py seeded FuzzedConnection): partitions, heals, latency shaping;
+- **process** (chaos/process.py + libs/fail.py handlers): hard kills that
+  drop the WAL's in-memory buffer, WAL tail truncation/corruption, restarts.
+
+`ChaosSchedule.generate(seed, ...)` produces the fault timeline as a pure
+function of its seed — re-running with the same seed reproduces the same
+schedule bit-for-bit (`fingerprint()` pins it). `ChaosEngine` walks the
+schedule against an adapter (the in-process `LocalChaosNet` harness, or any
+object with the same method names). See docs/ROBUSTNESS.md.
+"""
+
+from tendermint_tpu.chaos.device import DeviceFaultError, DeviceFaultInjector
+from tendermint_tpu.chaos.engine import ChaosEngine
+from tendermint_tpu.chaos.schedule import ChaosSchedule, FaultEvent
+
+__all__ = [
+    "ChaosEngine",
+    "ChaosSchedule",
+    "DeviceFaultError",
+    "DeviceFaultInjector",
+    "FaultEvent",
+]

@@ -1,0 +1,58 @@
+"""Byzantine behaviors for chaos soaks (reference test model:
+consensus/byzantine_test.go:35).
+
+`install_equivocator` swaps a node's prevote behavior via the hook the state
+machine exposes for exactly this (cs_state.do_prevote): each round it signs
+the honest prevote AND a conflicting prevote for a fabricated BlockID with
+the RAW key (a byzantine validator ignores the double-sign guard), then
+gossips the conflict. A fabricated hash can never equal the honest prevote,
+so EVERY round produces a detectable equivocation — the honest nodes must
+turn it into DuplicateVoteEvidence and commit it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+
+
+def install_equivocator(node) -> None:
+    from tendermint_tpu.consensus.messages import VoteMessage, encode_message
+    from tendermint_tpu.consensus.reactor import VOTE_CHANNEL
+    from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+    from tendermint_tpu.types.vote import Vote
+
+    cs = node.consensus
+    orig_do_prevote = cs._default_do_prevote
+
+    def byz_do_prevote(height: int, round_: int) -> None:
+        orig_do_prevote(height, round_)
+        rs = cs.rs
+        addr = node.priv_validator.get_pub_key().address()
+        idx, _ = rs.validators.get_by_address(addr)
+        if idx < 0:
+            return
+        vote = Vote(
+            type=SignedMsgType.PREVOTE,
+            height=height,
+            round=round_,
+            block_id=BlockID(b"\x42" * 32, PartSetHeader(1, b"\x42" * 32)),
+            timestamp_ns=time.time_ns(),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        sig = node.priv_validator.priv_key.sign(vote.sign_bytes(cs.state.chain_id))
+        vote = dataclasses.replace(vote, signature=sig)
+
+        async def gossip():
+            try:
+                await node.switch.broadcast(
+                    VOTE_CHANNEL, encode_message(VoteMessage(vote))
+                )
+            except Exception:
+                pass  # a dying switch mid-chaos must not kill the loop
+
+        asyncio.ensure_future(gossip())
+
+    cs.do_prevote = byz_do_prevote

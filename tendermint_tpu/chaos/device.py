@@ -1,0 +1,91 @@
+"""Device-level fault injection for the batch-verify pipeline.
+
+Installs into crypto/batch.py's `_device_fault(site)` hook, which every
+device entry point calls (RLC submit, RLC result sync, the per-signature
+kernel, the circuit breaker's health probe). Armed faults fire on the next
+device calls regardless of site — exactly what a sick accelerator looks like
+from the host: every dispatch fails or stalls, whichever kernel it carries.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+class DeviceFaultError(RuntimeError):
+    """The injected stand-in for a device/tunnel failure."""
+
+
+class DeviceFaultInjector:
+    """Count-armed fault source. Thread-safe: the consensus event loop, the
+    prewarm thread, and the breaker's probe thread can all hit device entry
+    points concurrently.
+
+    arm_errors(k): the next k device calls raise DeviceFaultError.
+    arm_hang(s):   the next device call sleeps s seconds first (a stall the
+                   caller experiences as a slow flush — the breaker's
+                   flush-deadline overrun path).
+    persistent:    raise on EVERY call until heal() (a dead tunnel).
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._lock = threading.Lock()
+        self._errors_left = 0
+        self._hangs: List[float] = []
+        self._persistent = False
+        self._clock = clock
+        self.calls = 0  # total device-entry calls observed
+        self.fired: List[Tuple[str, str]] = []  # (site, "error"|"hang")
+
+    # -- arming -------------------------------------------------------------
+
+    def arm_errors(self, count: int) -> None:
+        with self._lock:
+            self._errors_left += max(0, int(count))
+
+    def arm_hang(self, seconds: float) -> None:
+        with self._lock:
+            self._hangs.append(float(seconds))
+
+    def set_persistent(self, on: bool = True) -> None:
+        with self._lock:
+            self._persistent = bool(on)
+
+    def heal(self) -> None:
+        with self._lock:
+            self._errors_left = 0
+            self._hangs.clear()
+            self._persistent = False
+
+    # -- the hook (crypto/batch.set_device_fault_hook) ----------------------
+
+    def __call__(self, site: str) -> None:
+        with self._lock:
+            self.calls += 1
+            hang: Optional[float] = self._hangs.pop(0) if self._hangs else None
+            fire_error = self._persistent or self._errors_left > 0
+            if not self._persistent and self._errors_left > 0:
+                self._errors_left -= 1
+            if hang is not None:
+                self.fired.append((site, "hang"))
+            if fire_error:
+                self.fired.append((site, "error"))
+        if hang is not None:
+            time.sleep(hang)  # the device call "stalls"
+        if fire_error:
+            raise DeviceFaultError(f"injected device fault at {site}")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def install(self) -> "DeviceFaultInjector":
+        from tendermint_tpu.crypto import batch
+
+        batch.set_device_fault_hook(self)
+        return self
+
+    def uninstall(self) -> None:
+        from tendermint_tpu.crypto import batch
+
+        batch.set_device_fault_hook(None)

@@ -83,6 +83,15 @@ class P2PConfig:
     dial_timeout: float = 3.0
     # test-only adversarial I/O (reference: config/config.go TestFuzz)
     test_fuzz: bool = False
+    # deterministic fuzz: seed for the FuzzedConnection rng streams (0 = the
+    # reference's non-reproducible behavior); each upgraded connection derives
+    # its own stream from (seed, connection ordinal) so a failing fuzz run
+    # replays from its seed (p2p/fuzz.py, docs/ROBUSTNESS.md)
+    fuzz_seed: int = 0
+    # plaintext transport (no secret-connection upgrade): in-process test
+    # nets and minimal containers without the `cryptography` wheel. NEVER
+    # for production — peers are unauthenticated.
+    plaintext: bool = False
 
 
 @dataclass
@@ -163,6 +172,25 @@ class ConsensusConfig:
 
 
 @dataclass
+class CryptoConfig:
+    """Verify-path circuit breaker (crypto/circuit_breaker.py; no reference
+    counterpart — the reference's serial host loop has no device to break
+    away from). The breaker is process-global like the rest of the crypto
+    pipeline; the last Node constructed in a process wins."""
+
+    # trip TPU->CPU-serial after this many CONSECUTIVE device failures
+    breaker_enabled: bool = True
+    breaker_failure_threshold: int = 3
+    # a flush slower than this (seconds) counts as a deadline overrun;
+    # breaker_failure_threshold consecutive overruns also trip. 0 disables
+    # the deadline (flush time varies hugely with first-compile costs).
+    breaker_flush_deadline: float = 0.0
+    # health-probe backoff while OPEN: base doubles per failed probe up to max
+    breaker_probe_base: float = 1.0
+    breaker_probe_max: float = 60.0
+
+
+@dataclass
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
@@ -190,6 +218,7 @@ class Config:
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    crypto: CryptoConfig = field(default_factory=CryptoConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
     root_dir: str = ""
 

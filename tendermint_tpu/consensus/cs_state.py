@@ -1037,7 +1037,20 @@ class ConsensusState:
                 self.rs.validators.total_voting_power(),
                 val.voting_power if val else 0,
             )
-            self.evpool.add_evidence_from_consensus(ev, time.time_ns(), self.rs.validators)
+            fail.fail_point("cs_evidence_from_consensus")
+            try:
+                self.evpool.add_evidence_from_consensus(
+                    ev, time.time_ns(), self.rs.validators
+                )
+            except Exception as err:
+                # The pool verifies before accepting (evidence/pool.py); a
+                # rejected add means the evidence would never survive peer
+                # validation anyway — log loudly, keep consensus running.
+                logger.error(
+                    "evidence pool rejected consensus-discovered equivocation "
+                    "by %s at %d/%d: %s",
+                    vote.validator_address.hex()[:12], vote.height, vote.round, err,
+                )
 
     def _flush_deferred_votes(self) -> None:
         """Deferred-verification tick: batch-verify all queued votes in one

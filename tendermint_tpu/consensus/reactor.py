@@ -555,12 +555,26 @@ class ConsensusReactor(Reactor):
                 picked: List[object] = []
                 if rs.height == ps.height and rs.votes is not None:
                     # current height: prevotes/precommits for peer's round,
-                    # POL prevotes, our round's votes
-                    for votes in (
+                    # POL prevotes, our round's votes. The OUR-round sets are
+                    # the round-catchup path (reference: reactor.go
+                    # gossipVotesForHeight's final rs.Round clause): a peer
+                    # that restarted or healed from a partition sits rounds
+                    # behind and can only skip forward on +2/3 ANY at a later
+                    # round — which it can never assemble unless same-height
+                    # peers send votes from rounds ABOVE its own (the
+                    # receiver files them under its peer-catchup rounds,
+                    # round_state.py:116). Without this, a lagging validator
+                    # crawls one timeout-stretched round at a time while the
+                    # quorum needs it — the chaos soak's restart wedge.
+                    candidates = [
                         rs.votes.prevotes(ps.round) if ps.round >= 0 else None,
                         rs.votes.precommits(ps.round) if ps.round >= 0 else None,
                         rs.votes.prevotes(ps.proposal_pol_round) if ps.proposal_pol_round >= 0 else None,
-                    ):
+                    ]
+                    if 0 <= ps.round < rs.round:
+                        candidates.append(rs.votes.prevotes(rs.round))
+                        candidates.append(rs.votes.precommits(rs.round))
+                    for votes in candidates:
                         picked = (
                             ps.pick_votes_to_send(votes, self.VOTE_GOSSIP_BATCH)
                             if votes else []

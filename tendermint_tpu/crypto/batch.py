@@ -36,10 +36,75 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from tendermint_tpu.crypto.circuit_breaker import VerifyCircuitBreaker
 from tendermint_tpu.crypto.ed25519_ref import L
 from tendermint_tpu.libs import trace as _trace
 
 L8 = 8 * L  # full curve-group order; scalar modulus for torsion-exact RLC
+
+# ---------------------------------------------------------------------------
+# Device fault injection (chaos engine) + the verify-path circuit breaker.
+#
+# `_device_fault(site)` is called at every device entry point (RLC submit,
+# RLC finish/sync, the per-signature kernel, the breaker's health probe);
+# the chaos engine installs a hook there (chaos/device.DeviceFaultInjector)
+# that can raise or hang to model a sick accelerator, exercising the full
+# degradation ladder: RLC -> per-sig -> CPU -> breaker-OPEN (sticky CPU).
+#
+# The BREAKER makes persistent failure sticky: `_verify_batch_routed` gates
+# the jax path on `allow_device()`, records every device flush outcome, and
+# degrades a failed flush to the host loop instead of raising into the
+# consensus receive loop. A daemon probe thread re-arms the device path
+# (crypto/circuit_breaker.py; config: `[crypto] breaker_*`).
+
+_DEVICE_FAULT_HOOK = None  # callable(site: str) -> None; may raise/sleep
+
+
+def set_device_fault_hook(fn) -> None:
+    """Install (or clear, with None) the chaos device-fault hook."""
+    global _DEVICE_FAULT_HOOK
+    _DEVICE_FAULT_HOOK = fn
+
+
+def _device_fault(site: str) -> None:
+    hook = _DEVICE_FAULT_HOOK
+    if hook is not None:
+        hook(site)
+
+
+def _degrade_flush_to_cpu(pubkeys, msgs, sigs, exc: BaseException) -> np.ndarray:
+    """The in-flush ladder (RLC -> per-sig) is exhausted: the device itself
+    is failing. Record the failure toward the breaker's trip, then recompute
+    THIS flush on the host — the consensus receive loop must never see a
+    device error. Shared by the sync route and the async finish path so the
+    two degrade identically."""
+    BREAKER.record_failure(repr(exc))
+    import logging
+
+    logging.getLogger("tendermint_tpu.crypto.batch").exception(
+        "device verification failed; degrading flush to CPU"
+    )
+    return verify_batch_cpu(pubkeys, msgs, sigs)
+
+
+def _breaker_probe() -> None:
+    """Health probe for the OPEN breaker: one tiny device round trip through
+    the same fault hook real flushes pass (chaos-injected device faults keep
+    the breaker open). Deliberately compile-free — a device_put + fetch
+    answers 'is the device/tunnel alive', which is the observed failure mode
+    (BENCH_r05: even a tiny dispatch never returned)."""
+    _device_fault("probe")
+    import jax
+
+    np.asarray(jax.device_put(np.arange(8, dtype=np.int32)))
+
+
+BREAKER = VerifyCircuitBreaker(probe=_breaker_probe)
+
+
+def configure_breaker(**kwargs) -> None:
+    """Apply `[crypto]` breaker config (node/node.py)."""
+    BREAKER.configure(**kwargs)
 
 _BUCKET_SIZES = [2**i for i in range(17)]  # jit shape buckets: 1..65536
 
@@ -508,6 +573,7 @@ def _rlc_submit(
     from tendermint_tpu.crypto.ed25519_ref import BASE, point_compress
     from tendermint_tpu.ops import msm_jax
 
+    _device_fault("rlc_submit")
     t0 = time.perf_counter()
     n = len(pubkeys)
     mixed = key_types is not None and any(t == "sr25519" for t in key_types)
@@ -687,6 +753,7 @@ def _rlc_finish(call: _RlcCall) -> Optional[np.ndarray]:
     precheck, n, na = call.precheck, call.n, call.na
     t_sync = time.perf_counter()
     try:
+        _device_fault("rlc_finish")
         out = np.asarray(call.dev)  # [batch_ok, lane_ok...]
     except Exception as e:
         _trace.mark_device_call(ok=False, error=repr(e))
@@ -926,6 +993,7 @@ def verify_batch_jax(
     a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
     t_dev = time.perf_counter()
     try:
+        _device_fault("persig")
         if sharded is not None:
             LAST_JAX_PATH[0] = "sharded"
             mask = np.asarray(sharded(a, r, s_bits, h_bits))[:n]
@@ -1023,6 +1091,7 @@ def verify_batch_submit(
     mixed = key_types is not None and any(t != "ed25519" for t in key_types)
     eligible = (
         be == "jax"
+        and BREAKER.allow_device()
         and _rlc_enabled()
         and len(pubkeys) >= max(RLC_MIN, _JAX_MIN_BATCH if backend is None else 0)
         and _sharded_runner() is None
@@ -1055,13 +1124,28 @@ def verify_batch_finish(h: BatchHandle) -> np.ndarray:
     tr = _trace.tracer if _trace.tracer.enabled else None  # single flag check
     # total spans submit through finish (h._t0); prep happened at submit
     t0 = h._t0 if h._t0 is not None else time.perf_counter()
+    # breaker deadline clock starts at FINISH: submit-to-finish includes
+    # host-side queueing (the caller batches finishes deliberately), which
+    # must not read as device slowness and trip the flush deadline
+    t_fin = time.perf_counter()
     try:
-        if tr is not None:
+        if not BREAKER.allow_device():
+            # OPEN means no device work AT ALL: in the hang failure mode a
+            # sync on an already-submitted handle blocks for the full device
+            # timeout — once per queued handle. Abandon the in-flight result
+            # and recover below on the host.
+            mask = None
+        elif tr is not None:
             with tr.span("rlc.finish", n=len(pubkeys), async_=True):
                 mask = _rlc_finish(h._call)
         else:
             mask = _rlc_finish(h._call)
-    except Exception:
+    except Exception as e:
+        # a device failure, not a combined-check failure: count it toward
+        # the breaker's trip so the per-sig fallback below can short-circuit
+        # to CPU once the threshold is hit (instead of re-dispatching every
+        # queued handle into a dead device)
+        BREAKER.record_failure(repr(e))
         import logging
 
         logging.getLogger("tendermint_tpu.crypto.batch").exception(
@@ -1071,6 +1155,7 @@ def verify_batch_finish(h: BatchHandle) -> np.ndarray:
     detail = dict(LAST_FLUSH_DETAIL)
     if mask is not None:
         h._mask = mask
+        BREAKER.record_success(time.perf_counter() - t_fin)
         _trace.record_flush(
             backend="jax",
             path="rlc-async",
@@ -1092,17 +1177,44 @@ def verify_batch_finish(h: BatchHandle) -> np.ndarray:
     if mixed:
         LAST_FLUSH_DETAIL["rlc_fallback"] = True
         h._mask = _verify_batch_mixed_exact(pubkeys, msgs, sigs, key_types, backend)
+    elif not BREAKER.allow_device():
+        # The handle was submitted before the breaker tripped (e.g. an
+        # earlier finish in this same drain opened it): recover on the host
+        # instead of dispatching yet another doomed device call — OPEN means
+        # no device work, including for in-flight handles.
+        h._mask = verify_batch_cpu(pubkeys, msgs, sigs)
+        _trace.record_flush(
+            backend="cpu",
+            path="cpu-breaker",
+            n=len(pubkeys),
+            total_s=time.perf_counter() - t0,
+            n_valid=int(h._mask.sum()),
+            rlc_fallback=True,
+            tracer_=tr,
+        )
     else:
         from tendermint_tpu.ops.ed25519_jax import verify_prepared
 
         a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
         t_dev = time.perf_counter()
         try:
+            _device_fault("persig")
             h._mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n] & precheck
         except Exception as e:
             _trace.mark_device_call(ok=False, error=repr(e))
-            raise
+            h._mask = _degrade_flush_to_cpu(pubkeys, msgs, sigs, e)
+            _trace.record_flush(
+                backend="cpu",
+                path="cpu-degraded",
+                n=len(pubkeys),
+                total_s=time.perf_counter() - t0,
+                n_valid=int(h._mask.sum()),
+                rlc_fallback=True,
+                tracer_=tr,
+            )
+            return h._mask
         _trace.mark_device_call(ok=True)
+        BREAKER.record_success(time.perf_counter() - t_dev)
         _trace.record_flush(
             backend="jax",
             path="persig-async",
@@ -1195,6 +1307,7 @@ def _verify_batch_routed(
         # types/vote_set.go:203 — serial there, one batch here).
         if (
             be == "jax"
+            and BREAKER.allow_device()
             and _rlc_enabled()
             and len(pubkeys) >= RLC_MIN
             and _sharded_runner() is None
@@ -1228,7 +1341,17 @@ def _verify_batch_routed(
     if be == "cpu":
         return verify_batch_cpu(pubkeys, msgs, sigs), "cpu", "cpu"
     if be == "jax":
-        return verify_batch_jax(pubkeys, msgs, sigs), "jax", LAST_JAX_PATH[0]
+        if not BREAKER.allow_device():
+            # Breaker OPEN: sticky CPU degrade — no device submit, no retry
+            # storm; the probe thread re-arms the device path out of band.
+            return verify_batch_cpu(pubkeys, msgs, sigs), "cpu", "cpu-breaker"
+        t_dev = time.perf_counter()
+        try:
+            mask = verify_batch_jax(pubkeys, msgs, sigs)
+        except Exception as e:
+            return _degrade_flush_to_cpu(pubkeys, msgs, sigs, e), "cpu", "cpu-degraded"
+        BREAKER.record_success(time.perf_counter() - t_dev)
+        return mask, "jax", LAST_JAX_PATH[0]
     raise ValueError(f"unknown crypto backend {be!r}")
 
 

@@ -19,6 +19,13 @@ class EvidenceError(Exception):
     pass
 
 
+class EvidenceWindowError(EvidenceError):
+    """Evidence outside this node's acceptance window (expired, or the
+    validator set at its height is no longer stored). NOT peer misconduct:
+    an honest peer whose state lags/leads ours can legitimately offer it
+    (the reactor must not score these against the sender)."""
+
+
 def _pending_key(ev) -> bytes:
     return b"EV:pending:" + struct.pack(">q", ev.height) + ev.hash()
 
@@ -73,10 +80,12 @@ class EvidencePool:
             raise EvidenceError("evidence was already committed")
         ev.validate_basic()
         if self._is_expired(state, ev.height, ev.timestamp_ns):
-            raise EvidenceError("evidence is expired")
+            raise EvidenceWindowError("evidence is expired")
         vals = self.state_store.load_validators(ev.height)
         if vals is None:
-            raise EvidenceError(f"no validator set at evidence height {ev.height}")
+            raise EvidenceWindowError(
+                f"no validator set at evidence height {ev.height}"
+            )
         _, val = vals.get_by_address(ev.address())
         if val is None:
             raise EvidenceError("validator in evidence is not in the validator set")
@@ -102,9 +111,31 @@ class EvidencePool:
 
     def add_evidence_from_consensus(self, ev, time_ns: int, val_set) -> None:
         """Evidence discovered locally by consensus (conflicting votes)
-        (reference: evidence/pool.go AddEvidenceFromConsensus)."""
+        (reference: evidence/pool.go AddEvidenceFromConsensus).
+
+        Consensus already verified the two vote signatures on intake, but the
+        pool is the LAST gate before this evidence is gossiped, proposed, and
+        committed — so it re-checks everything it can against the validator
+        set consensus saw the conflict in: structural validity, expiry, set
+        membership, and both conflicting signatures. A bug (or a chaos-
+        corrupted intake path) upstream must surface HERE as a rejected add,
+        not as an invalid-evidence block proposal that every honest peer
+        rejects."""
+        if not isinstance(ev, DuplicateVoteEvidence):
+            raise EvidenceError(f"unknown evidence type {type(ev)}")
         if self.is_pending(ev) or self.is_committed(ev):
             return
+        ev.validate_basic()
+        if self._state is not None:
+            if self._is_expired(self._state, ev.height, ev.timestamp_ns):
+                raise EvidenceWindowError("evidence from consensus is already expired")
+            if val_set is not None:
+                _, val = val_set.get_by_address(ev.address())
+                if val is None:
+                    raise EvidenceError(
+                        "evidence validator is not in the conflict's validator set"
+                    )
+                ev.verify(self._state.chain_id, val.pub_key)
         self.db.set(_pending_key(ev), ev.encode())
 
     def update(self, state: State, committed_evidence) -> None:

@@ -60,11 +60,32 @@ class EvidenceReactor(Reactor):
             logger.error("bad evidence msg from %s: %s", peer.id[:10], e)
             await self.switch.stop_peer_for_error(peer, e)
             return
+        from tendermint_tpu.evidence.pool import EvidenceWindowError
+
         for ev in evs:
             try:
                 self.evpool.add_evidence(ev)
+            except EvidenceWindowError as e:
+                # benign race: honest peers with lagging/leading state offer
+                # evidence outside OUR window — drop, never score
+                logger.info("dropped out-of-window evidence from %s: %s", peer.id[:10], e)
             except Exception as e:
+                # INVALID evidence (bad sigs, wrong set, forged powers) is
+                # peer misconduct — it costs every receiver two signature
+                # verifications; score it so a spammer eventually trips the
+                # trust threshold (p2p/behaviour.py).
                 logger.info("rejected evidence from %s: %s", peer.id[:10], e)
+                try:
+                    from tendermint_tpu.p2p.behaviour import (
+                        BAD_MESSAGE,
+                        PeerBehaviour,
+                    )
+
+                    await self.switch.reporter.report(
+                        PeerBehaviour(peer.id, BAD_MESSAGE, f"bad evidence: {e}")
+                    )
+                except Exception:
+                    pass
 
     async def _broadcast_routine(self, peer) -> None:
         """Periodically offer all pending evidence the peer may lack
@@ -78,6 +99,11 @@ class EvidenceReactor(Reactor):
                     ok = await peer.send(EVIDENCE_CHANNEL, encode_evidence_list(fresh))
                     if ok:
                         sent.update(ev.hash() for ev in fresh)
+                if len(sent) > 4096:
+                    # bound the per-peer dedup set on a long-lived connection:
+                    # evidence that left the pending set (committed/expired)
+                    # no longer needs suppressing
+                    sent &= {ev.hash() for ev in pending}
                 await asyncio.sleep(BROADCAST_SLEEP)
         except asyncio.CancelledError:
             pass

@@ -1,16 +1,38 @@
 """Fail-point crash injection (reference: libs/fail/fail.go).
 
-Set TMTPU_FAIL_INDEX=<n>; the n-th fail point hit in the process aborts it
-hard (os._exit), simulating a crash at that exact ordering point. Used by the
-crash-recovery test matrix around the commit/apply sequence
-(reference: state/execution.go:143-189, consensus/state.go:746,
-test/persist/test_failure_indices.sh)."""
+Two injection mechanisms share the fail-point call sites:
+
+1. Env-driven hard crash (the original matrix): set TMTPU_FAIL_INDEX=<n>;
+   the n-th fail point hit in the process aborts it hard (os._exit),
+   simulating a crash at that exact ordering point. Used by the
+   crash-recovery test matrix around the commit/apply sequence
+   (reference: state/execution.go:143-189, consensus/state.go:746,
+   test/persist/test_failure_indices.sh).
+
+2. Programmatic handlers (the chaos engine's in-process mode): `inject()`
+   registers a callable for a NAMED fail point; when that point is hit the
+   handler runs and may raise (e.g. SimulatedCrash) to crash the component
+   without killing the test process — the multinode chaos harness pairs
+   this with chaos.process.hard_kill to model crash/restart cycles
+   deterministically (tendermint_tpu/chaos/).
+"""
 
 from __future__ import annotations
 
 import os
+from typing import Callable, Dict, Optional
 
 _counter = 0
+
+# name -> handler; consulted BEFORE the env counter so a chaos schedule can
+# target a specific ordering point by name instead of by global hit index.
+_HANDLERS: Dict[str, Callable[[], None]] = {}
+
+
+class SimulatedCrash(Exception):
+    """Raised by injected fail-point handlers to crash a component in-process
+    (the consensus receive loop treats any escaped exception as a consensus
+    failure and halts — the in-process analog of os._exit)."""
 
 
 def fail_index() -> int:
@@ -25,8 +47,23 @@ def reset() -> None:
     _counter = 0
 
 
+def inject(name: str, handler: Optional[Callable[[], None]]) -> None:
+    """Register (or, with None, remove) a handler for a named fail point."""
+    if handler is None:
+        _HANDLERS.pop(name, None)
+    else:
+        _HANDLERS[name] = handler
+
+
+def clear_injections() -> None:
+    _HANDLERS.clear()
+
+
 def fail_point(name: str = "") -> None:
     global _counter
+    handler = _HANDLERS.get(name)
+    if handler is not None:
+        handler()  # may raise (SimulatedCrash) back into the caller
     target = fail_index()
     if target < 0:
         return

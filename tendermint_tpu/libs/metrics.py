@@ -393,6 +393,10 @@ class P2PMetrics:
             f"{ns}_pending_send_messages",
             "Messages waiting in per-channel send queues, summed over peers.",
         )
+        self.reconnect_attempts = reg.counter(
+            f"{ns}_reconnect_attempts_total",
+            "Persistent-peer reconnect dial attempts (p2p/switch.py backoff loop).",
+        )
 
 
 class StateMetrics:
@@ -521,6 +525,23 @@ class BatchVerifyMetrics:
             f"{NAMESPACE}_device_last_call_timestamp_seconds",
             "Unix time of the last successful device call (age = now - this).",
         )
+        # verify-path circuit breaker (crypto/circuit_breaker.py): trips flip
+        # default-routed verification TPU->CPU-serial until a health probe
+        # passes (docs/ROBUSTNESS.md)
+        self.breaker_state = reg.gauge(
+            f"{ns}_breaker_state",
+            "Circuit breaker state: 0=closed (TPU), 1=open (CPU), 2=half-open (probing).",
+        )
+        self.breaker_trips = reg.counter(
+            f"{ns}_breaker_trips_total",
+            "Circuit-breaker trips (verify path degraded TPU->CPU).",
+            ("reason",),
+        )
+        self.breaker_probes = reg.counter(
+            f"{ns}_breaker_probes_total",
+            "Device health-probe attempts while the breaker is tripped.",
+            ("result",),
+        )
 
 
 class PubSubMetrics:
@@ -536,6 +557,20 @@ class PubSubMetrics:
         )
 
 
+class ChaosMetrics:
+    """tendermint_tpu/chaos engine accounting: how many faults a soak/smoke
+    injected per level. Exposed so a chaos run's /metrics scrape shows the
+    injected load next to the recovery counters it caused (breaker trips,
+    reconnects, rlc fallbacks)."""
+
+    def __init__(self, reg: Registry):
+        self.faults_injected = reg.counter(
+            f"{NAMESPACE}_chaos_faults_injected_total",
+            "Faults injected by the chaos engine.",
+            ("level",),
+        )
+
+
 # Process-global registry: series owned by process-global subsystems (the
 # crypto batch pipeline, the AOT kernel cache, pubsub overflow accounting)
 # rather than a Node instance.
@@ -543,15 +578,17 @@ _GLOBAL_LOCK = threading.Lock()
 _GLOBAL_REGISTRY: Optional[Registry] = None
 _BATCH_METRICS: Optional[BatchVerifyMetrics] = None
 _PUBSUB_METRICS: Optional[PubSubMetrics] = None
+_CHAOS_METRICS: Optional[ChaosMetrics] = None
 
 
 def global_registry() -> Registry:
-    global _GLOBAL_REGISTRY, _BATCH_METRICS, _PUBSUB_METRICS
+    global _GLOBAL_REGISTRY, _BATCH_METRICS, _PUBSUB_METRICS, _CHAOS_METRICS
     with _GLOBAL_LOCK:
         if _GLOBAL_REGISTRY is None:
             _GLOBAL_REGISTRY = Registry()
             _BATCH_METRICS = BatchVerifyMetrics(_GLOBAL_REGISTRY)
             _PUBSUB_METRICS = PubSubMetrics(_GLOBAL_REGISTRY)
+            _CHAOS_METRICS = ChaosMetrics(_GLOBAL_REGISTRY)
         return _GLOBAL_REGISTRY
 
 
@@ -563,6 +600,11 @@ def batch_metrics() -> BatchVerifyMetrics:
 def pubsub_metrics() -> PubSubMetrics:
     global_registry()
     return _PUBSUB_METRICS
+
+
+def chaos_metrics() -> ChaosMetrics:
+    global_registry()
+    return _CHAOS_METRICS
 
 
 class NodeMetrics:

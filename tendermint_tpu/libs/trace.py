@@ -294,6 +294,14 @@ def verify_stats() -> dict:
             "last_flush": dict(_LAST_FLUSH),
         }
     out["device"] = device_health()
+    try:
+        # lazy: batch imports this module at load time; the reverse edge
+        # only exists at call time
+        from tendermint_tpu.crypto.batch import BREAKER
+
+        out["breaker"] = BREAKER.snapshot()
+    except Exception:  # telemetry must never fail the stats read
+        pass
     return out
 
 

@@ -74,6 +74,18 @@ class Node:
         from tendermint_tpu.crypto.keys import set_verify_mode
 
         set_verify_mode(getattr(config.base, "ed25519_verify_mode", "cofactored"))
+        # verify-path circuit breaker knobs (process-global, same model as
+        # the verify mode: the crypto pipeline is shared by every in-process
+        # node, and the last Node constructed wins)
+        from tendermint_tpu.crypto import batch as _batch
+
+        _batch.configure_breaker(
+            enabled=config.crypto.breaker_enabled,
+            failure_threshold=config.crypto.breaker_failure_threshold,
+            flush_deadline_s=config.crypto.breaker_flush_deadline,
+            probe_interval_base=config.crypto.breaker_probe_base,
+            probe_interval_max=config.crypto.breaker_probe_max,
+        )
         self._owns_priv_validator = False
         if priv_validator is None and config.base.priv_validator_addr:
             # dial the remote signer (reference: node/node.go:658
@@ -241,6 +253,18 @@ class Node:
                     "p2p.laddr is configured but the p2p transport is "
                     "unavailable (missing `cryptography` wheel)"
                 )
+            if not config.p2p.plaintext:
+                from tendermint_tpu.p2p.conn.secret_connection import (
+                    HAVE_CRYPTOGRAPHY,
+                )
+
+                if not HAVE_CRYPTOGRAPHY:
+                    raise ImportError(
+                        "p2p.laddr is configured with secret connections but "
+                        "the `cryptography` wheel is missing; set "
+                        "p2p.plaintext=true for unauthenticated in-process "
+                        "test nets"
+                    )
             if config.root_dir:
                 self.node_key = NodeKey.load_or_gen(
                     os.path.join(config.root_dir, "config", "node_key.json")
@@ -257,8 +281,15 @@ class Node:
             if config.p2p.test_fuzz:
                 from tendermint_tpu.p2p.fuzz import FuzzConfig
 
-                fuzz_cfg = FuzzConfig()
-            transport = MultiplexTransport(self.node_key, node_info, fuzz_config=fuzz_cfg)
+                # seeded => every fuzzed connection's fault sequence replays
+                # from [p2p] fuzz_seed (see transport._upgrade's derivation)
+                fuzz_cfg = FuzzConfig(seed=config.p2p.fuzz_seed)
+            transport = MultiplexTransport(
+                self.node_key,
+                node_info,
+                use_secret_conn=not config.p2p.plaintext,
+                fuzz_config=fuzz_cfg,
+            )
             trust_path = (
                 os.path.join(config.root_dir, "data", "trust_metrics.json")
                 if config.root_dir

@@ -8,10 +8,10 @@ from tendermint_tpu.p2p.node_info import NodeInfo, parse_addr
 from tendermint_tpu.p2p.peer import Peer, PeerSet
 
 try:
-    # The wire transport's SecretConnection needs the `cryptography` wheel.
-    # Minimal containers run nodes in-process without p2p — the routing and
-    # reactor types above must stay importable there (consensus/reactor.py
-    # imports this package), so the networked pieces are gated.
+    # SecretConnection's `cryptography` import is itself gated now (the
+    # plaintext transport runs in minimal containers — that's how the chaos
+    # smoke/soak nets exist everywhere), so this import normally succeeds;
+    # the guard stays for any transitive import the wheel still owns.
     from tendermint_tpu.p2p.switch import Switch
     from tendermint_tpu.p2p.transport import MultiplexTransport
 except ImportError:  # pragma: no cover - exercised in minimal containers

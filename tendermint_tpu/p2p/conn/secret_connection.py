@@ -21,13 +21,27 @@ import hashlib
 import struct
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives import serialization
-from cryptography.exceptions import InvalidTag
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.exceptions import InvalidTag
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # minimal containers: plaintext transport only
+    # The module stays importable so the transport/switch layer (which only
+    # needs SecretConnection for isinstance checks and the opt-in encrypted
+    # upgrade) works in plaintext mode (`use_secret_conn=False`) without the
+    # wheel — the in-process multinode/chaos harness runs everywhere.
+    HAVE_CRYPTOGRAPHY = False
+    X25519PrivateKey = X25519PublicKey = ChaCha20Poly1305 = serialization = None
+
+    class InvalidTag(Exception):  # type: ignore[no-redef]
+        pass
+
 
 from tendermint_tpu.crypto.keys import Ed25519PubKey, PrivKey, PubKey
 
@@ -89,6 +103,11 @@ class SecretConnection:
     @classmethod
     async def upgrade(cls, reader, writer, priv_key: PrivKey) -> "SecretConnection":
         """(reference: secret_connection.go:92 MakeSecretConnection)"""
+        if not HAVE_CRYPTOGRAPHY:
+            raise ImportError(
+                "secret connection requires the `cryptography` wheel "
+                "(use plaintext transport for in-process tests)"
+            )
         eph_priv = X25519PrivateKey.generate()
         eph_pub = eph_priv.public_key().public_bytes(
             serialization.Encoding.Raw, serialization.PublicFormat.Raw
@@ -210,6 +229,10 @@ class SyncSecretConnection:
 
     @classmethod
     def upgrade(cls, sock, priv_key: PrivKey) -> "SyncSecretConnection":
+        if not HAVE_CRYPTOGRAPHY:
+            raise ImportError(
+                "secret connection requires the `cryptography` wheel"
+            )
         eph_priv = X25519PrivateKey.generate()
         eph_pub = eph_priv.public_key().public_bytes(
             serialization.Encoding.Raw, serialization.PublicFormat.Raw

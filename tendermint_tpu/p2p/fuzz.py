@@ -6,6 +6,14 @@ Wraps any object exposing async read(n)/write(data) + close() (the stream
 interface MConnection drives). Two modes, like the reference:
   "drop":  after start_after seconds, drop reads/writes with prob_drop_rw
   "delay": sleep a random interval up to max_delay before each read/write
+
+Reproducibility: the reference's FuzzedConnection draws from the global rand
+and wall clock, so a failing fuzz run can never be replayed. Here both are
+injectable — `seed` (threaded through `[p2p] fuzz_seed`, see
+config/config.py and transport.py's per-connection derivation) pins the
+drop/delay decision sequence, and `clock` pins the activation boundary — so
+the same seed reproduces the same fault pattern bit-for-bit (pinned by
+tests/test_chaos.py::test_fuzzed_connection_replay).
 """
 
 from __future__ import annotations
@@ -14,11 +22,13 @@ import asyncio
 import random
 import time
 from dataclasses import dataclass
+from typing import Callable, Optional
 
 
 @dataclass
 class FuzzConfig:
-    """reference: config/config.go FuzzConnConfig defaults."""
+    """reference: config/config.go FuzzConnConfig defaults, plus `seed`
+    (0 = non-deterministic, the reference behavior)."""
 
     mode: str = "drop"  # "drop" | "delay"
     max_delay: float = 3.0
@@ -26,18 +36,31 @@ class FuzzConfig:
     prob_drop_conn: float = 0.0
     prob_sleep: float = 0.0
     start_after: float = 10.0
+    seed: int = 0
 
 
 class FuzzedConnection:
-    def __init__(self, inner, config: FuzzConfig | None = None, rng: random.Random | None = None):
+    def __init__(
+        self,
+        inner,
+        config: FuzzConfig | None = None,
+        rng: random.Random | None = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
         self.inner = inner
         self.config = config or FuzzConfig()
-        self.rng = rng or random.Random()
-        self._born = time.monotonic()
+        if rng is None:
+            # seeded config without an explicit rng: still deterministic
+            # (single-connection uses; the transport derives per-connection
+            # rngs so concurrent connections don't share one stream)
+            rng = random.Random(self.config.seed) if self.config.seed else random.Random()
+        self.rng = rng
+        self._clock = clock or time.monotonic
+        self._born = self._clock()
         self._closed = False
 
     def _active(self) -> bool:
-        return time.monotonic() - self._born >= self.config.start_after
+        return self._clock() - self._born >= self.config.start_after
 
     async def _fuzz(self) -> bool:
         """Returns True if the op should be dropped."""

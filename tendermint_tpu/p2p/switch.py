@@ -50,8 +50,18 @@ class Switch:
         self.max_peers = max_peers
         self.persistent_addrs: Dict[str, str] = {}  # peer id -> addr
         self._tasks: List[asyncio.Task] = []
+        # Reconnect routines tracked SEPARATELY (peer id -> task): they sleep
+        # up to 0.5*2^6 s between attempts, so stop() must cancel AND await
+        # them (a bare fire-and-forget task would outlive the switch and dial
+        # from a stopped node). One task per peer id — a flapping peer must
+        # not accumulate concurrent reconnect loops.
+        self._reconnect_tasks: Dict[str, asyncio.Task] = {}
         self._running = False
         self._dialing: set[str] = set()
+        # Chaos/partition hook: when set, a peer id this predicate rejects
+        # can neither be dialed nor accepted (tendermint_tpu/chaos/harness.py
+        # partitions an in-process net by installing group filters).
+        self._conn_filter = None
 
     @property
     def node_info(self):
@@ -119,10 +129,24 @@ class Switch:
         self.metrics.recv_rate_bytes.set(recv_rate)
         self.metrics.pending_send_messages.set(pending)
 
+    def set_conn_filter(self, fn) -> None:
+        """Install (or clear, with None) a peer-id connection filter. Applies
+        to dials, inbound upgrades, and reconnect attempts."""
+        self._conn_filter = fn
+
+    def _conn_allowed(self, peer_id: str) -> bool:
+        return self._conn_filter is None or not peer_id or self._conn_filter(peer_id)
+
     async def stop(self) -> None:
         self._running = False
         for t in self._tasks:
             t.cancel()
+        reconnects = list(self._reconnect_tasks.values())
+        for t in reconnects:
+            t.cancel()
+        if reconnects:
+            await asyncio.gather(*reconnects, return_exceptions=True)
+        self._reconnect_tasks.clear()
         for peer in self.peers.list():
             await self._stop_and_remove_peer(peer, None)
         for reactor in self.reactors.values():
@@ -152,6 +176,8 @@ class Switch:
     async def dial_peer(self, addr: str, persistent: bool = False) -> Optional[Peer]:
         """Dial 'id@host:port' and add the peer."""
         peer_id, _, _ = parse_addr(addr)
+        if not self._conn_allowed(peer_id):
+            raise ConnectionError(f"dial to {peer_id[:10]} blocked by conn filter")
         if peer_id and (self.peers.has(peer_id) or peer_id in self._dialing):
             return self.peers.get(peer_id)
         self._dialing.add(peer_id)
@@ -171,14 +197,15 @@ class Switch:
                 logger.info("dial %s failed: %s", a, e)
                 if persistent:
                     pid, _, _ = parse_addr(a)
-                    self._tasks.append(
-                        asyncio.create_task(self._reconnect_routine(a, pid))
-                    )
+                    self._spawn_reconnect(a, pid)
 
         await asyncio.gather(*(_one(a) for a in addrs))
 
     async def _add_peer(self, conn: Connection, persistent: bool = False) -> Peer:
         ni = conn.node_info
+        if not self._conn_allowed(ni.node_id):
+            conn.transport.close()
+            raise ConnectionError(f"peer {ni.node_id[:10]} blocked by conn filter")
         if self.peers.has(ni.node_id):
             conn.transport.close()
             raise ValueError(f"duplicate peer {ni.node_id}")
@@ -232,9 +259,7 @@ class Switch:
                 f"{peer.id}@{peer.socket_addr}" if peer.outbound else None
             )
             if addr:
-                self._tasks.append(
-                    asyncio.create_task(self._reconnect_routine(addr, peer.id))
-                )
+                self._spawn_reconnect(addr, peer.id)
 
     async def _stop_and_remove_peer(self, peer: Peer, reason) -> None:
         self.peers.remove(peer.id)
@@ -251,6 +276,25 @@ class Switch:
             except Exception:
                 logger.exception("reactor remove_peer failed")
 
+    def _spawn_reconnect(self, addr: str, peer_id: str) -> None:
+        """Track one reconnect routine per peer id; done tasks self-evict so
+        the map doesn't grow with peer churn (the old bare create_task +
+        append-to-_tasks leaked a completed task per flap and left sleepers
+        alive across stop())."""
+        existing = self._reconnect_tasks.get(peer_id)
+        if existing is not None and not existing.done():
+            return
+        task = asyncio.create_task(
+            self._reconnect_routine(addr, peer_id), name=f"sw-reconnect-{peer_id[:8]}"
+        )
+        self._reconnect_tasks[peer_id] = task
+
+        def _evict(t, pid=peer_id):
+            if self._reconnect_tasks.get(pid) is t:
+                del self._reconnect_tasks[pid]
+
+        task.add_done_callback(_evict)
+
     async def _reconnect_routine(self, addr: str, peer_id: str) -> None:
         """Exponential backoff reconnect (reference: p2p/switch.go:379)."""
         for attempt in range(RECONNECT_ATTEMPTS):
@@ -258,11 +302,23 @@ class Switch:
                 return
             delay = RECONNECT_BASE_DELAY * (2 ** min(attempt, 6)) * (0.5 + random.random())
             await asyncio.sleep(delay)
+            if not self._running or self.peers.has(peer_id):
+                return
+            if self.metrics is not None:
+                self.metrics.reconnect_attempts.inc()
             try:
                 await self.dial_peer(addr, persistent=True)
                 return
             except Exception as e:
                 logger.debug("reconnect %s attempt %d failed: %s", addr, attempt, e)
+
+    async def disconnect_peer(self, peer_id: str, reason: str = "disconnect") -> None:
+        """Drop a live peer connection WITHOUT spawning a reconnect routine
+        (chaos partitions cut links; healing re-dials explicitly)."""
+        peer = self.peers.get(peer_id)
+        if peer is not None:
+            logger.info("disconnecting peer %s: %s", peer_id[:10], reason)
+            await self._stop_and_remove_peer(peer, reason)
 
     # -- broadcast ---------------------------------------------------------
 

@@ -46,6 +46,11 @@ class MultiplexTransport:
         # adversarial I/O injection for tests (reference: p2p/fuzz.go wired
         # via config TestFuzz); wraps every upgraded stream when set
         self.fuzz_config = fuzz_config
+        # per-connection ordinal for deterministic fuzz: with a seeded
+        # FuzzConfig the i-th upgraded connection always gets the SAME rng
+        # stream (seed*M + i), so a fuzz run replays from its seed even
+        # with several concurrent connections (each has its own rng)
+        self._fuzz_conn_ordinal = 0
         self._server: Optional[asyncio.base_events.Server] = None
         self._accept_queue: asyncio.Queue = asyncio.Queue(maxsize=64)
         self.listen_addr = ""
@@ -129,9 +134,19 @@ class MultiplexTransport:
             raise TransportError("connected to self")
         self.node_info.compatible_with(peer_ni)
         if self.fuzz_config is not None:
+            import random
+
             from tendermint_tpu.p2p.fuzz import FuzzedConnection
 
-            transport = FuzzedConnection(transport, self.fuzz_config)
+            rng = None
+            if getattr(self.fuzz_config, "seed", 0):
+                self._fuzz_conn_ordinal += 1
+                # int-derived seed (NOT a tuple: tuple seeding goes through
+                # PYTHONHASHSEED-randomized hash() and would not replay)
+                rng = random.Random(
+                    self.fuzz_config.seed * 1_000_003 + self._fuzz_conn_ordinal
+                )
+            transport = FuzzedConnection(transport, self.fuzz_config, rng=rng)
         return Connection(transport, peer_ni, outbound, "")
 
 

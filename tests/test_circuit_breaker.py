@@ -1,0 +1,322 @@
+"""Verify-path circuit breaker: trip/probe state machine, the batch-routing
+integration (persistent device failure => sticky CPU within one flush, no
+per-flush retry storm), and the /debug/verify_stats surface."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.chaos.device import DeviceFaultError, DeviceFaultInjector
+from tendermint_tpu.crypto import batch
+from tendermint_tpu.crypto.circuit_breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    VerifyCircuitBreaker,
+)
+from tendermint_tpu.crypto.keys import gen_ed25519
+from tendermint_tpu.libs import metrics as M
+
+
+def make_breaker(**kw):
+    kw.setdefault("spawn_probe_thread", False)
+    kw.setdefault("failure_threshold", 3)
+    return VerifyCircuitBreaker(**kw)
+
+
+def make_batch(n=6):
+    priv = gen_ed25519(b"\x07" * 32)
+    pk = priv.pub_key().bytes()
+    msgs = [b"msg-%d" % i for i in range(n)]
+    sigs = [priv.sign(m) for m in msgs]
+    return [pk] * n, msgs, sigs
+
+
+@pytest.fixture
+def restore_breaker():
+    """Swap in a deterministic breaker + clean fault hook, restore after."""
+    orig = batch.BREAKER
+    yield
+    batch.set_device_fault_hook(None)
+    batch.BREAKER = orig
+
+
+# ---------------------------------------------------------------------------
+# state machine
+
+
+def test_trips_only_after_consecutive_failures():
+    br = make_breaker()
+    br.record_failure("e1")
+    br.record_failure("e2")
+    assert br.state == CLOSED and br.allow_device()
+    br.record_success()  # success resets the streak
+    br.record_failure("e3")
+    br.record_failure("e4")
+    assert br.state == CLOSED
+    br.record_failure("e5")
+    assert br.state == OPEN and not br.allow_device()
+    snap = br.snapshot()
+    assert snap["trips"] == {"device_error": 1}
+    assert snap["state"] == "open"
+
+
+def test_flush_deadline_overruns_trip():
+    br = make_breaker(flush_deadline_s=0.1)
+    for _ in range(2):
+        br.record_success(duration_s=0.5)
+    assert br.state == CLOSED
+    br.record_success(duration_s=0.01)  # a fast flush resets the streak
+    for _ in range(2):
+        br.record_success(duration_s=0.5)
+    assert br.state == CLOSED
+    br.record_success(duration_s=0.5)
+    assert br.state == OPEN
+    assert br.snapshot()["trips"] == {"flush_deadline": 1}
+
+
+def test_probe_backoff_and_rearm():
+    healthy = [False]
+    probes = []
+
+    def probe():
+        probes.append(1)
+        if not healthy[0]:
+            raise RuntimeError("still sick")
+
+    br = make_breaker(probe=probe, probe_interval_base=1.0, probe_interval_max=4.0)
+    for _ in range(3):
+        br.record_failure("boom")
+    assert br.state == OPEN
+    assert br.probe_now() is False
+    assert br.state == OPEN
+    assert br.snapshot()["probe_backoff_s"] == 2.0  # doubled
+    assert br.probe_now() is False
+    assert br.snapshot()["probe_backoff_s"] == 4.0
+    assert br.probe_now() is False
+    assert br.snapshot()["probe_backoff_s"] == 4.0  # capped at max
+    healthy[0] = True
+    assert br.probe_now() is True
+    assert br.state == CLOSED and br.allow_device()
+    assert len(probes) == 4
+
+
+def test_probe_thread_rearms_in_background():
+    healthy = [False]
+
+    def probe():
+        if not healthy[0]:
+            raise RuntimeError("sick")
+
+    br = VerifyCircuitBreaker(
+        probe=probe, failure_threshold=1,
+        probe_interval_base=0.02, probe_interval_max=0.05,
+    )
+    br.record_failure("boom")
+    assert br.state != CLOSED
+    time.sleep(0.15)
+    assert br.state in (OPEN, HALF_OPEN)  # probes keep failing
+    healthy[0] = True
+    deadline = time.monotonic() + 2.0
+    while br.state != CLOSED and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert br.state == CLOSED
+
+
+def test_straggler_overrun_does_not_retrip_open_breaker():
+    """A slow flush submitted before the trip finishes late: it must not
+    re-trip (double-counted trips) nor reset the probe backoff mid-escalation."""
+    br = make_breaker(failure_threshold=1, flush_deadline_s=0.1,
+                      probe=lambda: (_ for _ in ()).throw(RuntimeError("sick")))
+    br.record_success(duration_s=0.5)
+    assert br.state == OPEN and br.snapshot()["trips"] == {"flush_deadline": 1}
+    br.probe_now()  # failed probe doubles the backoff
+    backoff = br.snapshot()["probe_backoff_s"]
+    assert backoff == 2.0
+    br.record_success(duration_s=9.9)  # the straggler
+    snap = br.snapshot()
+    assert snap["trips"] == {"flush_deadline": 1}  # not double-counted
+    assert snap["probe_backoff_s"] == backoff  # backoff escalation intact
+
+
+def test_probe_loop_exits_promptly_on_disable():
+    """configure(enabled=False) must wake the sleeping probe loop (the
+    wakeup event), not leave a thread sleeping out its 60s backoff."""
+    br = VerifyCircuitBreaker(
+        probe=lambda: (_ for _ in ()).throw(RuntimeError("sick")),
+        failure_threshold=1, probe_interval_base=30.0, probe_interval_max=60.0,
+    )
+    br.record_failure("boom")
+    assert br.state == OPEN
+    thread = br._probe_thread
+    assert thread is not None and thread.is_alive()
+    br.configure(enabled=False)
+    thread.join(timeout=2.0)
+    assert not thread.is_alive()
+    assert br.state == CLOSED
+
+
+def test_retrip_while_probe_thread_alive_keeps_a_prober():
+    """Device heals, probe closes the breaker, device flaps again immediately:
+    a probe loop must still be serving the new trip (the TOCTOU fix — the
+    exit decision and the thread-slot clear are atomic with the trip path)."""
+    healthy = [False]
+
+    def probe():
+        if not healthy[0]:
+            raise RuntimeError("sick")
+
+    br = VerifyCircuitBreaker(
+        probe=probe, failure_threshold=1,
+        probe_interval_base=0.01, probe_interval_max=0.02,
+    )
+    for _round in range(3):
+        healthy[0] = False
+        br.record_failure("boom")
+        assert br.state != CLOSED
+        healthy[0] = True
+        deadline = time.monotonic() + 2.0
+        while br.state != CLOSED and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert br.state == CLOSED, f"round {_round}: no prober re-armed the breaker"
+
+
+def test_disabled_breaker_never_trips():
+    br = make_breaker(enabled=False)
+    for _ in range(10):
+        br.record_failure("x")
+    assert br.state == CLOSED and br.allow_device()
+
+
+def test_configure_disable_recloses():
+    br = make_breaker()
+    for _ in range(3):
+        br.record_failure("x")
+    assert br.state == OPEN
+    br.configure(enabled=False)
+    assert br.state == CLOSED
+
+
+def test_breaker_metrics_written():
+    reg_before = M.batch_metrics().breaker_trips._values.copy()
+    br = make_breaker(probe=lambda: None)
+    for _ in range(3):
+        br.record_failure("x")
+    br.probe_now()
+    trips = M.batch_metrics().breaker_trips._values
+    assert trips.get(("device_error",), 0) > reg_before.get(("device_error",), 0)
+    assert M.batch_metrics().breaker_probes._values.get(("pass",), 0) >= 1
+    # state gauge ends closed (0) after the passing probe
+    assert M.batch_metrics().breaker_state._values.get((), None) == 0
+
+
+# ---------------------------------------------------------------------------
+# batch-routing integration
+
+
+def test_persistent_device_failure_degrades_then_breaks(restore_breaker):
+    """The acceptance check: under persistent device failure every flush
+    still returns the correct CPU mask, the breaker trips at the threshold,
+    and subsequent flushes never touch the device again (no retry storm)."""
+    batch.BREAKER = make_breaker(failure_threshold=2)
+    inj = DeviceFaultInjector().install()
+    inj.set_persistent(True)
+    pks, msgs, sigs = make_batch()
+    expect = batch.verify_batch_cpu(pks, msgs, sigs)
+
+    m1 = batch.verify_batch(pks, msgs, sigs, backend="jax")
+    assert np.array_equal(m1, expect)
+    assert batch.BREAKER.state == CLOSED
+    m2 = batch.verify_batch(pks, msgs, sigs, backend="jax")
+    assert np.array_equal(m2, expect)
+    assert batch.BREAKER.state == OPEN
+
+    calls_at_open = inj.calls
+    for _ in range(5):
+        mask = batch.verify_batch(pks, msgs, sigs, backend="jax")
+        assert np.array_equal(mask, expect)
+    assert inj.calls == calls_at_open  # breaker OPEN => zero device entries
+
+    # flush path label says what happened
+    from tendermint_tpu.libs import trace
+
+    stats = trace.verify_stats()
+    assert stats["totals"].get("cpu/cpu-breaker", {}).get("flushes", 0) >= 5
+    assert stats["breaker"]["state"] == "open"
+    assert stats["breaker"]["trips"].get("device_error") == 1
+
+    # heal + probe re-arms the device path
+    inj.heal()
+    assert batch.BREAKER.probe_now() is True
+    assert batch.BREAKER.allow_device()
+
+
+def test_breaker_open_skips_async_submit_device_work(restore_breaker):
+    """verify_batch_submit must not queue device work while OPEN — the
+    handle computes eagerly on CPU."""
+    batch.BREAKER = make_breaker(failure_threshold=1)
+    batch.BREAKER.record_failure("boom")
+    assert batch.BREAKER.state == OPEN
+    inj = DeviceFaultInjector().install()  # any device entry would raise below
+    inj.set_persistent(True)
+    pks, msgs, sigs = make_batch(8)
+    h = batch.verify_batch_submit(pks, msgs, sigs, backend="jax")
+    assert h._mask is not None  # eager: nothing in flight
+    mask = batch.verify_batch_finish(h)
+    assert np.array_equal(mask, batch.verify_batch_cpu(pks, msgs, sigs))
+    assert inj.calls == 0
+
+
+def test_inflight_handle_finish_respects_open_breaker(restore_breaker, monkeypatch):
+    """A handle SUBMITTED while closed whose finish runs after the breaker
+    opened must recover on CPU — OPEN means zero device work, including for
+    in-flight handles (the 'result never returns' device mode would
+    otherwise stall the consensus loop once per queued handle)."""
+    monkeypatch.setattr(batch, "RLC_MIN", 4)
+    batch.BREAKER = make_breaker(failure_threshold=1)
+    inj = DeviceFaultInjector().install()
+    pks, msgs, sigs = make_batch(8)
+    h1 = batch.verify_batch_submit(pks, msgs, sigs, backend="jax")
+    h2 = batch.verify_batch_submit(pks, msgs, sigs, backend="jax")
+    assert h1._mask is None and h1._call is not None  # genuinely in flight
+    # device dies while the handles are queued; the first finish's RLC sync
+    # fails and trips the breaker (threshold 1)
+    inj.set_persistent(True)
+    calls_before_finish = inj.calls
+    expect = batch.verify_batch_cpu(pks, msgs, sigs)
+    mask = batch.verify_batch_finish(h1)
+    assert np.array_equal(mask, expect)
+    assert batch.BREAKER.state == OPEN
+    # exactly ONE device entry (the failed rlc_finish); the per-sig fallback
+    # did NOT dispatch to the dead device
+    assert inj.calls == calls_before_finish + 1
+    assert inj.fired[-1][0] == "rlc_finish"
+    # the SECOND queued handle must not touch the device at all (in the
+    # hang mode even the sync would block for the full device timeout)
+    mask2 = batch.verify_batch_finish(h2)
+    assert np.array_equal(mask2, expect)
+    assert inj.calls == calls_before_finish + 1
+
+
+def test_injected_hang_counts_as_deadline_overrun(restore_breaker):
+    """A hanging device (chaos device_hang) trips via the flush deadline."""
+    batch.BREAKER = make_breaker(failure_threshold=1, flush_deadline_s=0.02)
+    inj = DeviceFaultInjector().install()
+    pks, msgs, sigs = make_batch()
+
+    def slow_verify(p, m, s):
+        inj("persig")  # consumes the armed hang
+        return batch.verify_batch_cpu(p, m, s)
+
+    import unittest.mock as mock
+
+    with mock.patch.object(batch, "verify_batch_jax", side_effect=slow_verify):
+        inj.arm_hang(0.05)
+        mask = batch.verify_batch(pks, msgs, sigs, backend="jax")
+    assert np.array_equal(mask, batch.verify_batch_cpu(pks, msgs, sigs))
+    assert batch.BREAKER.state == OPEN
+    assert batch.BREAKER.snapshot()["trips"] == {"flush_deadline": 1}

@@ -108,6 +108,88 @@ def test_bad_evidence_rejected():
         pool.add_evidence(forged)
 
 
+def test_add_from_consensus_validates_and_dedups():
+    """Satellite: add_evidence_from_consensus stored with ZERO validation —
+    now it must run basic checks, verify both signatures against the
+    conflict's validator set, and suppress duplicates."""
+    priv, state, _, pool = make_env()
+    import dataclasses as dc
+
+    state = dc.replace(
+        state, last_block_height=1, last_block_time_ns=1_700_000_100 * NANOS
+    )
+    pool.set_state(state)
+    ev = make_equivocation(priv)
+
+    pool.add_evidence_from_consensus(ev, ev.timestamp_ns, state.validators)
+    assert pool.is_pending(ev)
+    # duplicate suppression: second add is a no-op, not a second row
+    pool.add_evidence_from_consensus(ev, ev.timestamp_ns, state.validators)
+    assert len(pool.pending_evidence(-1)) == 1
+
+    # forged signature: rejected (this is the last gate before gossip)
+    forged = dc.replace(ev, vote_b=dc.replace(ev.vote_b, signature=b"\x01" * 64))
+    with pytest.raises(Exception):
+        pool.add_evidence_from_consensus(forged, ev.timestamp_ns, state.validators)
+    assert not pool.is_pending(forged)
+
+    # wrong order (fails validate_basic)
+    swapped = dc.replace(ev, vote_a=ev.vote_b, vote_b=ev.vote_a)
+    with pytest.raises(ValueError):
+        pool.add_evidence_from_consensus(swapped, ev.timestamp_ns, state.validators)
+
+    # validator outside the provided set
+    outsider = gen_ed25519(b"\x33" * 32)
+    with pytest.raises(EvidenceError):
+        pool.add_evidence_from_consensus(
+            make_equivocation(outsider), ev.timestamp_ns, state.validators
+        )
+
+    # expired at discovery time
+    params = state.consensus_params
+    future = dataclasses_replace_expired(state, params)
+    pool.set_state(future)
+    old = make_equivocation(priv, ts=1_000_000_000 * NANOS)
+    with pytest.raises(EvidenceError):
+        pool.add_evidence_from_consensus(old, old.timestamp_ns, state.validators)
+
+
+def dataclasses_replace_expired(state, params):
+    import dataclasses
+
+    return dataclasses.replace(
+        state,
+        last_block_height=1 + params.evidence.max_age_num_blocks + 1,
+        last_block_time_ns=1_000_000_000 * NANOS
+        + params.evidence.max_age_duration_ns
+        + NANOS,
+    )
+
+
+def test_pending_evidence_max_bytes_cap():
+    """Satellite: the max_bytes cap must bound what a proposal pulls — the
+    first evidence that would cross the cap is excluded, -1 is unbounded."""
+    priv, state, _, pool = make_env()
+    import dataclasses
+
+    state = dataclasses.replace(
+        state, last_block_height=1, last_block_time_ns=1_700_000_100 * NANOS
+    )
+    pool.set_state(state)
+    evs = [make_equivocation(priv, height=h) for h in (1, 2, 3)]
+    for ev in evs:
+        pool.add_evidence_from_consensus(ev, ev.timestamp_ns, state.validators)
+
+    allp = pool.pending_evidence(-1)
+    assert len(allp) == 3
+    # iteration order is key order (height ascending)
+    assert [e.height for e in allp] == [1, 2, 3]
+    first_len = len(allp[0].encode())
+    only_first = pool.pending_evidence(first_len)
+    assert [e.height for e in only_first] == [1]
+    assert pool.pending_evidence(0) == []
+
+
 def test_expired_evidence_rejected_and_pruned():
     priv, state, _, pool = make_env()
     import dataclasses

@@ -156,6 +156,7 @@ METRICS_SETS = (
     M.StateSyncMetrics,
     M.BatchVerifyMetrics,
     M.PubSubMetrics,
+    M.ChaosMetrics,
 )
 
 

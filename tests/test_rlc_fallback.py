@@ -1,0 +1,125 @@
+"""RLC degradation-ladder coverage (satellite: until now the
+`crypto/batch.py` RLC→per-sig fallback was only exercised by accident).
+
+Fast (tier-1) test: an injected device error mid-flush (the RLC submit call
+raises) must flip LAST_FLUSH_DETAIL["rlc_fallback"], land on the per-sig
+path, and produce a mask byte-identical to the CPU path — with the device
+kernel stubbed so tier-1 pays no compile.
+
+Slow tests: the same ladder over the REAL kernels, both the legitimate
+combined-check failure (one bad signature in the batch) and the injected
+device-error variant."""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.chaos.device import DeviceFaultInjector
+from tendermint_tpu.crypto import batch
+from tendermint_tpu.crypto.keys import gen_ed25519
+from tendermint_tpu.crypto.ed25519_ref import L
+
+
+def make_mixed_validity_batch(n=8):
+    """Valid signatures plus rows that fail PRECHECK (bad pubkey length,
+    non-canonical s) — rejected identically by every path, so a stubbed
+    device kernel can't mask a wrong verdict."""
+    priv = gen_ed25519(b"\x09" * 32)
+    pk = priv.pub_key().bytes()
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        m = b"ladder-%d" % i
+        pks.append(pk)
+        msgs.append(m)
+        sigs.append(priv.sign(m))
+    pks[2] = pk[:16]  # bad pubkey length
+    bad_s = sigs[5][:32] + L.to_bytes(32, "little")  # s == L: non-canonical
+    sigs[5] = bad_s
+    return pks, msgs, sigs
+
+
+@pytest.fixture
+def small_rlc(monkeypatch):
+    monkeypatch.setattr(batch, "RLC_MIN", 4)
+    yield
+    batch.set_device_fault_hook(None)
+
+
+def test_device_error_mid_flush_falls_back_persig_mask_identical(
+    small_rlc, monkeypatch
+):
+    """RLC submit raises (injected device error) -> per-sig fallback runs ->
+    mask byte-identical to CPU, rlc_fallback recorded. Device kernel stubbed
+    (all-true lanes); correctness is pinned by the precheck-failing rows."""
+    from tendermint_tpu.ops import ed25519_jax, msm_jax
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected mid-flush device error")
+
+    monkeypatch.setattr(msm_jax, "rlc_check_submit", boom)
+    monkeypatch.setattr(msm_jax, "rlc_check_cached_submit", boom)
+
+    def fake_verify_prepared(a, r, s_bits, h_bits):
+        return np.ones(a.shape[1], dtype=bool)
+
+    monkeypatch.setattr(ed25519_jax, "verify_prepared", fake_verify_prepared)
+
+    pks, msgs, sigs = make_mixed_validity_batch()
+    cpu = batch.verify_batch_cpu(pks, msgs, sigs)
+    mask = batch.verify_batch(pks, msgs, sigs, backend="jax")
+
+    assert mask.tobytes() == cpu.tobytes()  # byte-identical verdicts
+    assert batch.LAST_FLUSH_DETAIL.get("rlc_fallback") is True
+    assert batch.LAST_JAX_PATH[0] == "persig"
+    assert not mask[2] and not mask[5] and mask[0]
+
+
+def test_rlc_fallback_counter_reaches_metrics(small_rlc, monkeypatch):
+    from tendermint_tpu.libs import metrics as M
+    from tendermint_tpu.ops import ed25519_jax, msm_jax
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected")
+
+    monkeypatch.setattr(msm_jax, "rlc_check_submit", boom)
+    monkeypatch.setattr(msm_jax, "rlc_check_cached_submit", boom)
+    monkeypatch.setattr(
+        ed25519_jax,
+        "verify_prepared",
+        lambda a, r, s, h: np.ones(a.shape[1], dtype=bool),
+    )
+    before = M.batch_metrics().rlc_fallbacks._values.get((), 0)
+    pks, msgs, sigs = make_mixed_validity_batch()
+    batch.verify_batch(pks, msgs, sigs, backend="jax")
+    assert M.batch_metrics().rlc_fallbacks._values.get((), 0) == before + 1
+
+
+@pytest.mark.slow
+def test_real_kernels_bad_signature_fallback_byte_identical(small_rlc):
+    """Real device path: one genuinely bad signature makes the RLC combined
+    check fail; the per-sig kernel must recover the EXACT mask the CPU path
+    produces, and the fallback must be recorded."""
+    pks, msgs, sigs = make_mixed_validity_batch()
+    sigs[1] = sigs[1][:63] + bytes([sigs[1][63] ^ 1])  # corrupt one valid sig
+    cpu = batch.verify_batch_cpu(pks, msgs, sigs)
+    assert not cpu[1]
+    mask = batch.verify_batch(pks, msgs, sigs, backend="jax")
+    assert mask.tobytes() == cpu.tobytes()
+    assert batch.LAST_FLUSH_DETAIL.get("rlc_fallback") is True
+
+
+@pytest.mark.slow
+def test_real_kernels_injected_device_error_fallback(small_rlc):
+    """Real device path with a chaos-injected one-shot device error at the
+    RLC submit: the per-sig kernel (unfaulted) recovers the exact mask."""
+    inj = DeviceFaultInjector().install()
+    pks, msgs, sigs = make_mixed_validity_batch()
+    cpu = batch.verify_batch_cpu(pks, msgs, sigs)
+    inj.arm_errors(1)  # fires at rlc_submit; per-sig then passes
+    mask = batch.verify_batch(pks, msgs, sigs, backend="jax")
+    assert mask.tobytes() == cpu.tobytes()
+    assert batch.LAST_FLUSH_DETAIL.get("rlc_fallback") is True
+    assert ("rlc_submit", "error") in inj.fired
