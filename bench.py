@@ -36,6 +36,14 @@ Sub-benchmarks (in "extra", budget permitting):
                         CPU flush cost; open flushes must not touch the
                         device — device_calls_while_open is asserted 0),
                         and rearm_ms (heal -> passing probe -> TPU again)
+  overload            — the overload-protection scenario
+                        (docs/ROBUSTNESS.md "Overload protection"): a live
+                        node flooded with concurrent tx admissions;
+                        reports tx-admission latency (p50/p90/p99 us),
+                        eviction/TTL/rejection counts by reason, the
+                        overload controller's pressure snapshot, and
+                        block_interval_ratio (flooded vs unloaded — the
+                        acceptance bound is <= 2x)
 
 Flight-recorder breakdown (always in "extra", including the stall fallback):
   verify_stats  — per-stage pipeline telemetry from libs/trace.py:
@@ -802,6 +810,108 @@ def bench_chaos_recovery(n: int = 512):
         batch.BREAKER = orig_breaker
 
 
+def bench_overload():
+    """Overload scenario (docs/ROBUSTNESS.md "Overload protection"): a live
+    single-validator node flooded with tx admissions from concurrent
+    threads — the RPC-broadcast-burst shape without HTTP overhead. Reports
+    tx-admission latency under flood, the shed/eviction/rejection counts
+    the admission layer produced, and the block-interval delta vs the
+    unloaded baseline. Host-side by construction (no device work: admission
+    control is mempool/RPC/lock behavior)."""
+    import asyncio
+    import threading
+
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import gen_ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    cfg = test_config()
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = ""
+    cfg.root_dir = ""
+    import tempfile
+
+    cfg.consensus.wal_path = os.path.join(tempfile.mkdtemp(), "wal")
+    cfg.mempool.size = 500  # small enough that the flood saturates it
+    cfg.mempool.ttl_num_blocks = 4
+    cfg.overload.sample_interval = 0.05
+    priv = FilePV(gen_ed25519(b"\x71" * 32))
+    gen = GenesisDoc(
+        chain_id="bench-overload",
+        validators=[GenesisValidator(priv.get_pub_key(), 10)],
+    )
+    node = Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
+    # admission control is host-side; don't spend the bench budget compiling
+    # single-validator verify kernels in the prewarm thread
+    node._start_crypto_prewarm = lambda: None
+
+    BASELINE_HEIGHTS, FLOOD_HEIGHTS, N_FLOODERS = 8, 12, 4
+    lat: list = []
+    stop = threading.Event()
+
+    def flooder(k: int):
+        i = 0
+        while not stop.is_set():
+            tx = b"ov-%d-%d=x" % (k, i)
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                node.mempool.check_tx(tx)
+            except Exception:
+                pass
+            lat.append(time.perf_counter() - t0)
+
+    async def run():
+        await node.start()
+        try:
+            await node.wait_for_height(2, timeout=60)
+            h0 = node.block_store.height
+            t0 = time.perf_counter()
+            await node.wait_for_height(h0 + BASELINE_HEIGHTS, timeout=120)
+            baseline_s = (time.perf_counter() - t0) / BASELINE_HEIGHTS
+
+            threads = [
+                threading.Thread(target=flooder, args=(k,), daemon=True)
+                for k in range(N_FLOODERS)
+            ]
+            h1 = node.block_store.height
+            t1 = time.perf_counter()
+            for t in threads:
+                t.start()
+            await node.wait_for_height(h1 + FLOOD_HEIGHTS, timeout=300)
+            flood_s = (time.perf_counter() - t1) / FLOOD_HEIGHTS
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+            return baseline_s, flood_s
+        finally:
+            stop.set()
+            await node.stop()
+
+    baseline_s, flood_s = asyncio.run(run())
+    lat.sort()
+
+    def pct(p):
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1e6, 1) if lat else None
+
+    mm = node.metrics.mempool
+    rejected = {k[0]: int(v) for k, v in mm.rejected_txs._values.items()}
+    return {
+        "baseline_block_interval_ms": round(baseline_s * 1e3, 1),
+        "flood_block_interval_ms": round(flood_s * 1e3, 1),
+        "block_interval_ratio": round(flood_s / baseline_s, 2),
+        "admissions_attempted": len(lat),
+        "admission_latency_us": {"p50": pct(0.50), "p90": pct(0.90), "p99": pct(0.99)},
+        "evicted_txs": node.mempool.evicted_total,
+        "expired_txs": node.mempool.expired_total,
+        "rejected_txs": rejected,
+        "overload": node.overload.snapshot(),
+    }
+
+
 @contextlib.contextmanager
 def watchdog(seconds: float):
     """Abort a stage if it stalls: the device tunnel has been observed to
@@ -965,6 +1075,22 @@ def main():
             )
         except Exception as e:
             log(f"[chaos_recovery] FAILED: {e}")
+
+    if head is not None and remaining() > 90:
+        try:
+            with watchdog(max(80.0, remaining() - 40.0)):
+                ov = bench_overload()
+            extra["overload"] = ov
+            log(
+                f"[overload] block interval {ov['baseline_block_interval_ms']:.0f}"
+                f"->{ov['flood_block_interval_ms']:.0f} ms "
+                f"({ov['block_interval_ratio']}x) under "
+                f"{ov['admissions_attempted']:,} admissions "
+                f"(p99 {ov['admission_latency_us']['p99']} us, "
+                f"evicted {ov['evicted_txs']}, rejected {ov['rejected_txs']})"
+            )
+        except Exception as e:
+            log(f"[overload] FAILED: {e}")
 
     if head is not None and remaining() > 240:
         try:

@@ -21,6 +21,7 @@ from typing import Optional, Tuple
 from tendermint_tpu.abci import types as a
 from tendermint_tpu.abci import wire
 from tendermint_tpu.abci.client import ABCIClient
+from tendermint_tpu.libs import fail
 from tendermint_tpu.libs import protowire as pw
 
 logger = logging.getLogger("tendermint_tpu.abci.socket")
@@ -70,8 +71,14 @@ def _parse_addr(addr: str) -> Tuple[str, object]:
 class SocketClient(ABCIClient):
     """(reference: abci/client/socket_client.go)"""
 
-    def __init__(self, addr: str, connect_timeout: float = 10.0):
+    def __init__(
+        self,
+        addr: str,
+        connect_timeout: float = 10.0,
+        call_timeout: float = 30.0,
+    ):
         self.addr = addr
+        self.call_timeout = call_timeout  # per-call ([base] abci_call_timeout)
         kind, target = _parse_addr(addr)
         if kind == "unix":
             self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -83,6 +90,7 @@ class SocketClient(ABCIClient):
         self._wlock = threading.Lock()
         self._pending: "queue.Queue[Tuple[str, Future]]" = queue.Queue()
         self._closed = False
+        self._dead: Optional[Exception] = None  # reader died / socket broke
         self._reader = threading.Thread(target=self._recv_routine, daemon=True, name="abci-sock-recv")
         self._reader.start()
 
@@ -92,6 +100,9 @@ class SocketClient(ABCIClient):
             self._sock.close()
         except OSError:
             pass
+
+    def is_dead(self) -> bool:
+        return self._closed or self._dead is not None
 
     # -- plumbing ----------------------------------------------------------
 
@@ -111,6 +122,7 @@ class SocketClient(ABCIClient):
                     raise err
                 fut.set_result(msg)
         except Exception as e:
+            self._dead = e
             if not self._closed:
                 logger.error("ABCI socket reader died: %s", e)
             # fail all pending futures
@@ -123,6 +135,14 @@ class SocketClient(ABCIClient):
                     fut.set_exception(ConnectionError(str(e)))
 
     def _call_async(self, method: str, msg=None) -> Future:
+        if self.is_dead():
+            raise ConnectionError(
+                f"ABCI socket client is dead: {self._dead or 'closed'}"
+            )
+        # chaos hook: a registered handler can kill the app server (or this
+        # client's socket) mid-flight to exercise the reconnect path
+        # (docs/ROBUSTNESS.md fail-point catalog)
+        fail.fail_point("abci_client_call")
         fut: Future = Future()
         with self._wlock:
             self._pending.put((method, fut))
@@ -132,7 +152,7 @@ class SocketClient(ABCIClient):
     def _call(self, method: str, msg=None):
         fut = self._call_async(method, msg)
         self.flush()
-        return fut.result(timeout=30)
+        return fut.result(timeout=self.call_timeout)
 
     def flush(self) -> None:
         with self._wlock:
@@ -187,12 +207,12 @@ class SocketClient(ABCIClient):
         return self._call("apply_snapshot_chunk", req)
 
 
-def socket_client_creator(addr: str):
+def socket_client_creator(addr: str, call_timeout: float = 30.0):
     """ClientCreator for AppConns: one fresh connection per logical conn
     (reference: proxy/client.go NewRemoteClientCreator)."""
 
     def create() -> SocketClient:
-        return SocketClient(addr)
+        return SocketClient(addr, call_timeout=call_timeout)
 
     return create
 
@@ -214,6 +234,7 @@ class SocketServer:
         self._sock.listen(8)
         self._app_lock = threading.Lock()  # one app, many conns
         self._threads = []
+        self._conns: list = []  # live accepted sockets, closed on stop()
         self._running = False
         self.bound_addr = self._sock.getsockname()
 
@@ -224,11 +245,33 @@ class SocketServer:
         self._threads.append(t)
 
     def stop(self) -> None:
+        """Close the listener AND every accepted connection — a stopped app
+        must look dead to its clients immediately (their reads fail now, not
+        whenever the OS notices), which is what the reconnect path and the
+        chaos app-restart scenario key off."""
         self._running = False
+        try:
+            # shutdown BEFORE close: a thread blocked in accept() pins the
+            # open file description, so close() alone leaves the port in
+            # LISTEN until that accept returns — shutdown wakes it, making
+            # an immediate rebind (app restart on the same port) possible
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._conns.clear()
 
     def serve_forever(self) -> None:
         self.start()
@@ -245,6 +288,7 @@ class SocketServer:
                 return
             # daemon handler threads are not tracked: reconnecting clients
             # would otherwise accumulate dead Thread objects unboundedly
+            self._conns.append(conn)
             threading.Thread(target=self._handle_conn, args=(conn,), daemon=True).start()
 
     def _handle_conn(self, conn: socket.socket) -> None:
@@ -269,6 +313,10 @@ class SocketServer:
         except (ConnectionError, OSError):
             pass
         finally:
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
             try:
                 conn.close()
             except OSError:

@@ -18,6 +18,8 @@ from typing import Callable, Dict, Optional
 logger = logging.getLogger("tendermint_tpu.blocksync")
 
 REQUEST_WINDOW = 40  # max heights in flight (reference: maxPendingRequests-ish)
+# defaults for the [fastsync] peer_timeout / retry_sleep config knobs
+# (kept as module constants for tests and non-config callers)
 PEER_TIMEOUT = 10.0
 RETRY_SLEEP = 0.05
 
@@ -41,11 +43,15 @@ class _Requester:
 
 class BlockPool:
     def __init__(self, start_height: int, send_request: Callable, punish_peer: Callable,
-                 metrics=None):
+                 metrics=None, peer_timeout: float = PEER_TIMEOUT,
+                 retry_sleep: float = RETRY_SLEEP):
         """send_request(peer_id, height) -> awaitable; punish_peer(peer_id, reason);
-        metrics: an optional BlockSyncMetrics (num_peers / latest_block_height)."""
+        metrics: an optional BlockSyncMetrics (num_peers / latest_block_height);
+        peer_timeout/retry_sleep: [fastsync] knobs (defaults unchanged)."""
         self.height = start_height  # next height to pop
         self.metrics = metrics
+        self.peer_timeout = peer_timeout
+        self.retry_sleep = retry_sleep
         self._peers: Dict[str, _PoolPeer] = {}
         self._requesters: Dict[int, _Requester] = {}
         self._send_request = send_request
@@ -158,7 +164,9 @@ class BlockPool:
                 for req in list(self._requesters.values()):
                     if req.block is not None:
                         continue
-                    if req.peer_id and now - req.requested_at > PEER_TIMEOUT:
+                    if req.peer_id and now - req.requested_at > self.peer_timeout:
+                        if self.metrics is not None:
+                            self.metrics.peer_timeouts.inc()
                         await self._punish_peer(req.peer_id, "block request timeout")
                         self.remove_peer(req.peer_id)
                     if not req.peer_id:
@@ -169,7 +177,7 @@ class BlockPool:
                         req.requested_at = now
                         peer.pending += 1
                         await self._send_request(peer.peer_id, req.height)
-                await asyncio.sleep(RETRY_SLEEP)
+                await asyncio.sleep(self.retry_sleep)
         except asyncio.CancelledError:
             pass
         except Exception:

@@ -40,7 +40,8 @@ VERIFY_BATCH_BLOCKS = 16
 
 class BlocksyncReactor(Reactor):
     def __init__(self, state, block_exec, block_store, consensus_reactor=None,
-                 active: bool = True, metrics=None):
+                 active: bool = True, metrics=None,
+                 peer_timeout: float = None, retry_sleep: float = None):
         super().__init__("BLOCKSYNC")
         self.state = state
         self.block_exec = block_exec
@@ -48,6 +49,11 @@ class BlocksyncReactor(Reactor):
         self.consensus_reactor = consensus_reactor
         self.active = active  # False = serve blocks only (we're not syncing)
         self.metrics = metrics  # BlockSyncMetrics or None
+        # [fastsync] peer_timeout / retry_sleep (None = pool defaults)
+        from tendermint_tpu.blocksync.pool import PEER_TIMEOUT, RETRY_SLEEP
+
+        self.peer_timeout = PEER_TIMEOUT if peer_timeout is None else peer_timeout
+        self.retry_sleep = RETRY_SLEEP if retry_sleep is None else retry_sleep
         self.pool: Optional[BlockPool] = None
         self._tasks: List[asyncio.Task] = []
         self.synced = asyncio.Event()
@@ -65,6 +71,7 @@ class BlocksyncReactor(Reactor):
         self.pool = BlockPool(
             self.state.last_block_height + 1, self._send_request, self._punish_peer,
             metrics=self.metrics,
+            peer_timeout=self.peer_timeout, retry_sleep=self.retry_sleep,
         )
         self.pool.start()
         self._tasks = [

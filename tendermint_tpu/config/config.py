@@ -47,6 +47,16 @@ class BaseConfig:
     # strict superset (crafted small-torsion signatures), which is a
     # consensus-fork vector at the 2/3 boundary in a mixed fleet.
     ed25519_verify_mode: str = "cofactored"
+    # ABCI socket/grpc client resilience (abci/socket.py, proxy/multi.py).
+    # Per-call timeout (the reference's hardwired 30s in socket_client.go
+    # promoted to config); reconnect-with-backoff applies to the mempool/
+    # query/snapshot connections only — a CONSENSUS connection failure
+    # stays fatal-loud (reference: proxy/multi_app_conn.go kills the node
+    # on consensus-conn death).
+    abci_call_timeout: float = 30.0
+    abci_reconnect_attempts: int = 5
+    abci_reconnect_base_delay: float = 0.2
+    abci_reconnect_max_delay: float = 5.0
 
 
 @dataclass
@@ -62,6 +72,15 @@ class RPCConfig:
     max_body_bytes: int = 1000000
     # unlocks the unsafe_* routes (reference: rpc.unsafe in config.toml)
     unsafe: bool = False
+    # Load shedding (rpc/server.py): sheddable methods (broadcast_tx_*,
+    # queries/searches) run under a bounded concurrency gate; past
+    # max_inflight_requests they are refused immediately with HTTP 429 +
+    # Retry-After (JSON-RPC error -32005) instead of queueing without
+    # bound. Health/status/consensus-critical routes bypass the gate.
+    # 0 disables shedding.
+    max_inflight_requests: int = 256
+    # Retry-After seconds advertised on a shed response
+    shed_retry_after: float = 1.0
 
 
 @dataclass
@@ -92,6 +111,22 @@ class P2PConfig:
     # nets and minimal containers without the `cryptography` wheel. NEVER
     # for production — peers are unauthenticated.
     plaintext: bool = False
+    # Per-peer inbound admission control (p2p/conn/connection.py): token
+    # buckets per SHEDDABLE channel (mempool/pex/evidence declare
+    # sheddable=True on their ChannelDescriptor; consensus channels are
+    # exempt — votes are never rate-limited to zero). A message that finds
+    # its channel's bucket empty is dropped before reactor dispatch and
+    # counted; a peer that keeps flooding past its budget accumulates
+    # strikes and is reported to the trust scorer, then disconnected.
+    # 0 disables the corresponding bucket.
+    recv_rate_limit: bool = True
+    recv_rate_bytes_per_channel: int = 1_048_576  # bytes/s per sheddable channel
+    recv_rate_msgs_per_channel: int = 2000  # msgs/s per sheddable channel
+    # shed events within recv_rate_strike_window seconds before the peer is
+    # reported for rate-limit misbehavior (each report records bad conduct;
+    # repeated reports push the trust score under the disconnect threshold)
+    recv_rate_strikes: int = 200
+    recv_rate_strike_window: float = 10.0
 
 
 @dataclass
@@ -104,6 +139,21 @@ class MempoolConfig:
     cache_size: int = 10000
     keep_invalid_txs_in_cache: bool = False
     max_tx_bytes: int = 1048576
+    # Admission control (mempool/mempool.py). TTLs follow the reference's
+    # v0.35+ knobs (config/config.go TTLNumBlocks/TTLDuration): a tx older
+    # than ttl_seconds OR admitted more than ttl_num_blocks blocks ago is
+    # purged on the post-commit update. 0 disables.
+    ttl_num_blocks: int = 0
+    ttl_seconds: float = 0.0
+    # When full, evict lowest-priority/oldest resident txs to admit a
+    # higher-priority arrival instead of hard-erroring (the reference
+    # priority mempool's eviction); false restores the old "mempool is
+    # full" error behavior.
+    eviction: bool = True
+    # Per-sender in-flight cap for GOSSIPED txs (sender = peer id): one
+    # flooding peer cannot occupy the whole pool. 0 = unlimited. Locally
+    # submitted txs (RPC, empty sender) are not quota'd.
+    max_txs_per_sender: int = 0
 
 
 @dataclass
@@ -114,6 +164,9 @@ class StateSyncConfig:
     trust_hash: str = ""
     trust_period: float = 168 * 3600.0
     discovery_time: float = 15.0
+    # per-chunk fetch timeout before the chunk is re-requested from another
+    # peer (statesync/syncer.py; was a hardcoded CHUNK_TIMEOUT alongside
+    # this knob — the syncer now honors this value on the node path)
     chunk_request_timeout: float = 10.0
     chunk_fetchers: int = 4
 
@@ -121,6 +174,30 @@ class StateSyncConfig:
 @dataclass
 class FastSyncConfig:
     version: str = "v0"
+    # block-request timeout before the assigned peer is punished and the
+    # height re-requested, and the scheduler's poll sleep (blocksync/pool.py
+    # PEER_TIMEOUT/RETRY_SLEEP promoted to config with the same defaults)
+    peer_timeout: float = 10.0
+    retry_sleep: float = 0.05
+
+
+@dataclass
+class OverloadConfig:
+    """Node-level overload controller (node/overload.py; no reference
+    counterpart — the reference sheds implicitly via bounded goroutine
+    queues). Samples queue depths into a pressure level that flips the
+    shed switches in order: txs first, then non-critical gossip, never
+    votes."""
+
+    enabled: bool = True
+    sample_interval: float = 0.5
+    # fraction of capacity at which a single signal saturates (1.0);
+    # pressure level is derived from the max over all signals with
+    # hysteresis: ELEVATED at >= elevated_watermark, CRITICAL at
+    # >= critical_watermark, stepping back down only below 80% of the
+    # entering watermark (no shed/unshed flapping at the boundary)
+    elevated_watermark: float = 0.7
+    critical_watermark: float = 0.9
 
 
 @dataclass
@@ -217,6 +294,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
+    overload: OverloadConfig = field(default_factory=OverloadConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)

@@ -226,11 +226,19 @@ class ConsensusReactor(Reactor):
         self._peer_tasks: Dict[str, List[asyncio.Task]] = {}
 
     def get_channels(self) -> List[ChannelDescriptor]:
+        # NEVER sheddable: the overload shed order is txs -> non-critical
+        # gossip -> never votes (per-channel caps follow the reference's
+        # consensus maxMsgSize of 1MB; block parts are 64KB chunks)
+        cap = 1_048_576
         return [
-            ChannelDescriptor(STATE_CHANNEL, priority=6, send_queue_capacity=100),
-            ChannelDescriptor(DATA_CHANNEL, priority=10, send_queue_capacity=100),
-            ChannelDescriptor(VOTE_CHANNEL, priority=7, send_queue_capacity=100),
-            ChannelDescriptor(VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=2),
+            ChannelDescriptor(STATE_CHANNEL, priority=6, send_queue_capacity=100,
+                              recv_message_capacity=cap),
+            ChannelDescriptor(DATA_CHANNEL, priority=10, send_queue_capacity=100,
+                              recv_message_capacity=cap),
+            ChannelDescriptor(VOTE_CHANNEL, priority=7, send_queue_capacity=100,
+                              recv_message_capacity=cap),
+            ChannelDescriptor(VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=2,
+                              recv_message_capacity=cap),
         ]
 
     async def start(self) -> None:

@@ -34,9 +34,21 @@ class EvidenceReactor(Reactor):
         super().__init__("EVIDENCE")
         self.evpool = evpool
         self._peer_tasks: Dict[str, asyncio.Task] = {}
+        # flipped by the overload controller at CRITICAL pressure: pending
+        # evidence is re-offered once pressure clears, so pausing the walk
+        # delays inclusion without losing anything
+        self.shed = False
 
     def get_channels(self) -> List[ChannelDescriptor]:
-        return [ChannelDescriptor(EVIDENCE_CHANNEL, priority=6, send_queue_capacity=10)]
+        # sheddable: evidence gossip re-sends until ack'd by inclusion, so a
+        # shed message is retried — safe to drop under overload (reference
+        # maxMsgSize: evidence lists are bounded by consensus params)
+        return [
+            ChannelDescriptor(
+                EVIDENCE_CHANNEL, priority=6, send_queue_capacity=10,
+                recv_message_capacity=1_048_576, sheddable=True,
+            )
+        ]
 
     async def add_peer(self, peer) -> None:
         self._peer_tasks[peer.id] = asyncio.create_task(
@@ -93,6 +105,9 @@ class EvidenceReactor(Reactor):
         sent: set = set()
         try:
             while True:
+                if self.shed:
+                    await asyncio.sleep(BROADCAST_SLEEP)
+                    continue
                 pending = self.evpool.pending_evidence(-1)
                 fresh = [ev for ev in pending if ev.hash() not in sent]
                 if fresh:

@@ -365,6 +365,22 @@ class MempoolMetrics:
         )
         self.failed_txs = reg.counter(f"{ns}_failed_txs", "CheckTx failures.")
         self.recheck_times = reg.counter(f"{ns}_recheck_times", "Recheck runs.")
+        # admission control (mempool/mempool.py overload protection)
+        self.evicted_txs = reg.counter(
+            f"{ns}_evicted_txs_total",
+            "Resident txs evicted (LRU/lowest-priority) to admit new ones.",
+        )
+        self.expired_txs = reg.counter(
+            f"{ns}_expired_txs_total", "Txs purged by TTL on the post-commit update."
+        )
+        self.rejected_txs = reg.counter(
+            f"{ns}_rejected_txs_total",
+            "Txs refused at admission, by reason (full/cache/quota/too_large).",
+            ("reason",),
+        )
+        self.full = reg.gauge(
+            f"{ns}_full", "1 while the mempool is at capacity (the reactor sheds gossip)."
+        )
 
 
 class P2PMetrics:
@@ -396,6 +412,21 @@ class P2PMetrics:
         self.reconnect_attempts = reg.counter(
             f"{ns}_reconnect_attempts_total",
             "Persistent-peer reconnect dial attempts (p2p/switch.py backoff loop).",
+        )
+        # inbound admission control (p2p/conn/connection.py token buckets)
+        self.oversized_msgs = reg.counter(
+            f"{ns}_oversized_msgs_total",
+            "Inbound messages that exceeded their channel's recv_message_capacity.",
+            ("chID",),
+        )
+        self.rate_limited_msgs = reg.counter(
+            f"{ns}_rate_limited_msgs_total",
+            "Inbound messages shed by a sheddable channel's token bucket.",
+            ("chID",),
+        )
+        self.rate_limit_disconnects = reg.counter(
+            f"{ns}_rate_limit_disconnects_total",
+            "Peers reported for persistent rate-limit misbehavior.",
         )
 
 
@@ -431,6 +462,10 @@ class BlockSyncMetrics:
             f"{ns}_verify_seconds",
             "Wall seconds per batched commit-verification run (blocks x validators).",
         )
+        self.peer_timeouts = reg.counter(
+            f"{ns}_peer_timeouts_total",
+            "Peers punished for a block-request timeout (blocksync/pool.py).",
+        )
 
 
 class StateSyncMetrics:
@@ -453,6 +488,55 @@ class StateSyncMetrics:
         )
         self.chunks_applied_total = reg.counter(
             f"{ns}_chunks_applied_total", "Snapshot chunks applied via ABCI."
+        )
+
+
+class RPCMetrics:
+    """rpc/server.py load-shedding gate. No reference counterpart — the
+    reference bounds connections at the listener (MaxOpenConnections);
+    here the gate is per-request so health/consensus routes stay served
+    while broadcast/query traffic sheds."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_rpc"
+        self.inflight_requests = reg.gauge(
+            f"{ns}_inflight_requests",
+            "Sheddable RPC requests currently executing under the gate.",
+        )
+        self.shed_requests = reg.counter(
+            f"{ns}_shed_requests_total",
+            "Requests refused with 429 (gate full or overload pressure), by method.",
+            ("method",),
+        )
+
+
+class OverloadMetrics:
+    """node/overload.py pressure controller: sampled queue depths folded
+    into a pressure level and shed switches (docs/ROBUSTNESS.md,
+    'Overload protection')."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_overload"
+        self.pressure_level = reg.gauge(
+            f"{ns}_pressure_level",
+            "Overload pressure: 0=normal 1=elevated (txs shed) 2=critical "
+            "(non-critical gossip shed too). Votes are never shed.",
+        )
+        self.pressure = reg.gauge(
+            f"{ns}_pressure",
+            "Saturation [0,1] of each sampled signal.",
+            ("signal",),
+        )
+        self.transitions = reg.counter(
+            f"{ns}_transitions_total",
+            "Pressure-level changes, by direction (up/down).",
+            ("direction",),
+        )
+        self.shed = reg.counter(
+            f"{ns}_shed_total",
+            "Work units shed by surface (mempool_gossip/rpc/p2p arrivals "
+            "dropped while the corresponding switch was flipped).",
+            ("surface",),
         )
 
 
@@ -621,6 +705,8 @@ class NodeMetrics:
         self.state = StateMetrics(self.registry)
         self.blocksync = BlockSyncMetrics(self.registry)
         self.statesync = StateSyncMetrics(self.registry)
+        self.rpc = RPCMetrics(self.registry)
+        self.overload = OverloadMetrics(self.registry)
         NodeMetrics._latest = self
 
     @classmethod

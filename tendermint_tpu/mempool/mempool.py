@@ -9,6 +9,7 @@ plus the executor's explicit lock."""
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
@@ -19,12 +20,43 @@ from tendermint_tpu.crypto import tmhash
 
 
 class MempoolError(Exception):
-    pass
+    """Base admission-control rejection. `reason` is machine-readable so the
+    RPC layer can return a structured JSON-RPC error instead of a bare
+    traceback (full / cache / quota / too_large)."""
+
+    reason = "rejected"
+
+
+class MempoolFullError(MempoolError):
+    reason = "full"
+
+    def __init__(self, detail: str = ""):
+        super().__init__(
+            "mempool is full" + (f" ({detail})" if detail else "")
+        )
 
 
 class TxInCacheError(MempoolError):
+    reason = "cache"
+
     def __init__(self):
         super().__init__("tx already exists in cache")
+
+
+class SenderQuotaError(MempoolError):
+    reason = "quota"
+
+    def __init__(self, sender: str, quota: int):
+        super().__init__(
+            f"sender {sender[:10]} exceeds in-flight quota ({quota})"
+        )
+
+
+class TxTooLargeError(MempoolError):
+    reason = "too_large"
+
+    def __init__(self, size: int, max_size: int):
+        super().__init__(f"tx too large ({size} > {max_size})")
 
 
 @dataclass
@@ -33,6 +65,32 @@ class MempoolTx:
     height: int  # height when validated
     gas_wanted: int
     senders: frozenset = frozenset()  # peer IDs that sent us this tx
+    priority: int = 0  # app-assigned (ResponseCheckTx.priority); evict lowest first
+    time_ns: int = 0  # admission wall time (TTL + oldest-first eviction)
+    sender0: str = ""  # the admitting sender, charged against the quota
+
+
+def iter_mempool_wal(path: str):
+    """Yield txs from a mempool WAL (4-byte BE length + tx records),
+    stopping at the first torn/truncated record — the clean-prefix
+    semantics the consensus WAL's CRC framing gives, minus the CRC (the
+    mempool log is forensic, not safety-critical)."""
+    if not path:
+        return
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return
+    with f:
+        while True:
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                return
+            ln = int.from_bytes(hdr, "big")
+            tx = f.read(ln)
+            if len(tx) < ln:
+                return  # torn tail
+            yield tx
 
 
 class Mempool:
@@ -48,6 +106,11 @@ class Mempool:
         recheck: bool = True,
         metrics=None,
         wal_path: str = "",
+        max_tx_bytes: int = 1_048_576,
+        ttl_num_blocks: int = 0,
+        ttl_seconds: float = 0.0,
+        eviction: bool = True,
+        max_txs_per_sender: int = 0,
     ):
         self.metrics = metrics
         self._wal = None
@@ -56,8 +119,14 @@ class Mempool:
         self.proxy_app = proxy_app
         self.max_txs = max_txs
         self.max_txs_bytes = max_txs_bytes
+        self.max_tx_bytes = max_tx_bytes
         self.recheck = recheck
         self.keep_invalid_txs_in_cache = keep_invalid_txs_in_cache
+        # admission control ([mempool] ttl_*/eviction/max_txs_per_sender)
+        self.ttl_num_blocks = ttl_num_blocks
+        self.ttl_seconds = ttl_seconds
+        self.eviction = eviction
+        self.max_txs_per_sender = max_txs_per_sender
         self._txs: "OrderedDict[bytes, MempoolTx]" = OrderedDict()  # key: tx hash
         self._cache: "OrderedDict[bytes, None]" = OrderedDict()
         self._cache_size = cache_size
@@ -66,6 +135,9 @@ class Mempool:
         self._lock = threading.RLock()
         self._txs_available_cb: Optional[Callable[[], None]] = None
         self._notified_txs_available = False
+        self._sender_counts: Dict[str, int] = {}  # admitting sender -> in-flight txs
+        self.evicted_total = 0
+        self.expired_total = 0
 
     # -- locking around commit (reference: Lock/Unlock in Mempool iface) ----
 
@@ -118,10 +190,36 @@ class Mempool:
             _os.replace(self._wal_path, self._wal_path + ".old")
             self._wal = open(self._wal_path, "ab")
 
+    def replay_wal(self, path: str = "") -> int:
+        """Re-admit the WAL's surviving txs through check_tx (crash
+        forensics/recovery; the reference leaves replay to operators — here
+        it is a method so tests can pin that an EVICTED tx's WAL record
+        still replays cleanly: eviction un-caches, so replay re-admits).
+        Returns the number of txs accepted back into the pool."""
+        accepted = 0
+        # suspend the live WAL while replaying: check_tx would otherwise
+        # append every re-admitted tx onto the very file being iterated
+        # (doubling it per replay cycle)
+        with self._lock:
+            wal, self._wal = self._wal, None
+        try:
+            for tx in iter_mempool_wal(path or getattr(self, "_wal_path", "")):
+                try:
+                    res = self.check_tx(tx)
+                except MempoolError:
+                    continue
+                if res is not None and res.code == abci.CODE_TYPE_OK:
+                    accepted += 1
+        finally:
+            with self._lock:
+                self._wal = wal
+        return accepted
+
     def flush(self) -> None:
         with self._lock:
             self._txs.clear()
             self._cache.clear()
+            self._sender_counts.clear()
             self._total_bytes = 0
             # allow the next admitted tx to re-notify consensus — without this
             # a flush between notify and commit stalls proposal creation when
@@ -148,6 +246,16 @@ class Mempool:
             self._cache.popitem(last=False)
         return True
 
+    def _reject(self, exc: MempoolError, sender: str):
+        """Reject a tx at admission: gossiped txs (sender set) drop silently
+        (the reference updates sender lists and moves on), locally submitted
+        txs raise so the RPC layer can report the structured reason."""
+        if self.metrics is not None:
+            self.metrics.rejected_txs.labels(exc.reason).inc()
+        if sender:
+            return None
+        raise exc
+
     def check_tx(self, tx: bytes, sender: str = "") -> Optional[abci.ResponseCheckTx]:
         """(reference: mempool/clist_mempool.go:234 CheckTx + resCbFirstTime :404)
 
@@ -156,26 +264,45 @@ class Mempool:
         in the cache from a peer returns None instead of raising (the
         reference updates the sender list and drops it silently)."""
         with self._lock:
-            if self.is_full(len(tx)):
-                if sender:
-                    return None
-                raise MempoolError("mempool is full")
+            if len(tx) > self.max_tx_bytes:
+                return self._reject(TxTooLargeError(len(tx), self.max_tx_bytes), sender)
+            if (
+                sender
+                and self.max_txs_per_sender > 0
+                and self._sender_counts.get(sender, 0) >= self.max_txs_per_sender
+            ):
+                return self._reject(SenderQuotaError(sender, self.max_txs_per_sender), sender)
+            if self.is_full(len(tx)) and not self.eviction:
+                return self._reject(MempoolFullError(), sender)
             key = tmhash.sum256(tx)
             if not self._cache_push(key):
                 mtx = self._txs.get(key)
                 if mtx is not None and sender:
                     mtx.senders = mtx.senders | {sender}
                     return None
-                if sender:
-                    return None
-                raise TxInCacheError()
+                return self._reject(TxInCacheError(), sender)
             res = self.proxy_app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
             if res.code == abci.CODE_TYPE_OK:
+                # evict only for a genuinely NEW arrival: a duplicate of a
+                # resident tx whose hash churned out of the dedup cache must
+                # not destroy lower-priority residents to insert nothing
                 if key not in self._txs:
+                    if self.is_full(len(tx)) and not self._evict_for(len(tx), res.priority):
+                        # could not free room below the incoming tx's
+                        # priority: drop the NEW tx, and un-cache it so it
+                        # may re-enter once the pool drains
+                        self._cache.pop(key, None)
+                        return self._reject(
+                            MempoolFullError("no evictable lower-priority txs"), sender
+                        )
                     self._txs[key] = MempoolTx(
                         tx=tx, height=self._height, gas_wanted=res.gas_wanted,
                         senders=frozenset({sender}) if sender else frozenset(),
+                        priority=res.priority, time_ns=time.time_ns(),
+                        sender0=sender,
                     )
+                    if sender:
+                        self._sender_counts[sender] = self._sender_counts.get(sender, 0) + 1
                     self._total_bytes += len(tx)
                     self._wal_write(tx)
                     self._notify_txs_available()
@@ -184,11 +311,65 @@ class Mempool:
                     self._cache.pop(key, None)
                 if self.metrics is not None:
                     self.metrics.failed_txs.inc()
-            if self.metrics is not None:
-                self.metrics.size.set(len(self._txs))
-                self.metrics.size_bytes.set(self._total_bytes)
-                self.metrics.tx_size_bytes.observe(len(tx))
+            self._update_size_metrics(len(tx))
             return res
+
+    def _update_size_metrics(self, tx_len: Optional[int] = None) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.size.set(len(self._txs))
+        self.metrics.size_bytes.set(self._total_bytes)
+        self.metrics.full.set(1 if self.is_full(0) else 0)
+        if tx_len is not None:
+            self.metrics.tx_size_bytes.observe(tx_len)
+
+    def _remove_tx(self, key: bytes, *, drop_cache: bool) -> Optional[MempoolTx]:
+        """Remove a resident tx, keeping byte totals and sender quotas
+        consistent. drop_cache also forgets the hash so the tx may be
+        resubmitted later (evicted/expired txs must not be poisoned)."""
+        mtx = self._txs.pop(key, None)
+        if mtx is None:
+            return None
+        self._total_bytes -= len(mtx.tx)
+        if mtx.sender0:
+            n = self._sender_counts.get(mtx.sender0, 0) - 1
+            if n > 0:
+                self._sender_counts[mtx.sender0] = n
+            else:
+                self._sender_counts.pop(mtx.sender0, None)
+        if drop_cache:
+            self._cache.pop(key, None)
+        return mtx
+
+    def _evict_for(self, tx_len: int, priority: int) -> bool:
+        """Make room for an incoming (tx_len, priority) by evicting resident
+        txs in (priority asc, admission order) — lowest-priority first,
+        oldest first among equals; a resident tx with HIGHER priority than
+        the arrival is never evicted for it (reference: the v1 priority
+        mempool's CheckTx eviction). Returns False (state untouched) when
+        the arrival cannot fit within that constraint."""
+        victims = []
+        freed_bytes = 0
+        freed_slots = 0
+        need_slots = len(self._txs) + 1 - self.max_txs
+        need_bytes = self._total_bytes + tx_len - self.max_txs_bytes
+        # stable sort over insertion order: equal priorities evict oldest
+        for key, mtx in sorted(self._txs.items(), key=lambda kv: kv[1].priority):
+            if freed_slots >= need_slots and freed_bytes >= need_bytes:
+                break
+            if mtx.priority > priority:
+                return False  # only higher-priority txs left standing
+            victims.append(key)
+            freed_bytes += len(mtx.tx)
+            freed_slots += 1
+        if freed_slots < need_slots or freed_bytes < need_bytes:
+            return False
+        for key in victims:
+            self._remove_tx(key, drop_cache=True)
+            self.evicted_total += 1
+            if self.metrics is not None:
+                self.metrics.evicted_txs.inc()
+        return True
 
     def entries(self) -> List[tuple]:
         """Snapshot [(key, tx, senders)] in insertion order (gossip walk)."""
@@ -240,18 +421,41 @@ class Mempool:
             else:
                 if not self.keep_invalid_txs_in_cache:
                     self._cache.pop(key, None)
-            old = self._txs.pop(key, None)
-            if old is not None:
-                self._total_bytes -= len(old.tx)
+            self._remove_tx(key, drop_cache=False)
+        self._purge_expired()
         if self.recheck and self._txs:
             if self.metrics is not None:
                 self.metrics.recheck_times.inc()
             self._recheck_txs()
-        if self.metrics is not None:
-            self.metrics.size.set(len(self._txs))
-            self.metrics.size_bytes.set(self._total_bytes)
+        self._update_size_metrics()
         if self._txs:
             self._notify_txs_available()
+
+    def _purge_expired(self) -> None:
+        """TTL purge (reference: v0.35 mempool TTLNumBlocks/TTLDuration):
+        drop txs admitted more than ttl_num_blocks blocks ago or older than
+        ttl_seconds, un-caching them so a later resubmission is accepted.
+        Caller holds the lock; runs on every post-commit update."""
+        if self.ttl_num_blocks <= 0 and self.ttl_seconds <= 0:
+            return
+        now_ns = time.time_ns()
+        expired = [
+            key
+            for key, mtx in self._txs.items()
+            if (
+                self.ttl_num_blocks > 0
+                and self._height - mtx.height >= self.ttl_num_blocks
+            )
+            or (
+                self.ttl_seconds > 0
+                and now_ns - mtx.time_ns >= self.ttl_seconds * 1e9
+            )
+        ]
+        for key in expired:
+            self._remove_tx(key, drop_cache=True)
+            self.expired_total += 1
+            if self.metrics is not None:
+                self.metrics.expired_txs.inc()
 
     def _recheck_txs(self) -> None:
         for key in list(self._txs.keys()):
@@ -260,7 +464,6 @@ class Mempool:
                 abci.RequestCheckTx(tx=mtx.tx, type=abci.CHECK_TX_TYPE_RECHECK)
             )
             if res.code != abci.CODE_TYPE_OK:
-                del self._txs[key]
-                self._total_bytes -= len(mtx.tx)
-                if not self.keep_invalid_txs_in_cache:
-                    self._cache.pop(tmhash.sum256(mtx.tx), None)
+                self._remove_tx(
+                    key, drop_cache=not self.keep_invalid_txs_in_cache
+                )

@@ -18,6 +18,10 @@ logger = logging.getLogger("tendermint_tpu.mempool")
 
 MEMPOOL_CHANNEL = 0x30
 BROADCAST_SLEEP = 0.02
+# proto framing slack on top of the configured max tx size when computing
+# the channel's assembled-message cap (reference: mempool/reactor.go
+# calcMaxMsgSize over MaxTxBytes)
+MSG_OVERHEAD_BYTES = 4096
 
 
 def encode_txs(txs: List[bytes]) -> bytes:
@@ -32,14 +36,33 @@ def decode_txs(data: bytes) -> List[bytes]:
 
 
 class MempoolReactor(Reactor):
-    def __init__(self, mempool, broadcast: bool = True):
+    def __init__(self, mempool, broadcast: bool = True, metrics=None):
         super().__init__("MEMPOOL")
         self.mempool = mempool
         self.broadcast = broadcast
+        self.metrics = metrics  # OverloadMetrics or None
         self._peer_tasks: Dict[str, asyncio.Task] = {}
+        # Shed switch, flipped by the node's overload controller
+        # (node/overload.py): while set, inbound gossiped txs are dropped
+        # BEFORE the app CheckTx round-trip and the outbound walk pauses.
+        # Independently of the switch, a FULL mempool sheds inbound gossip
+        # (no point paying CheckTx for a tx that cannot be admitted).
+        self.shed = False
+        self.shed_rx = 0  # gossip messages dropped without decode/CheckTx
 
     def get_channels(self) -> List[ChannelDescriptor]:
-        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5, send_queue_capacity=128)]
+        # sheddable: under inbound overload, gossiped txs are the FIRST
+        # traffic dropped (votes never are — see ChannelDescriptor.sheddable).
+        # The cap derives from the CONFIGURED max tx size, so a fleet running
+        # raised [mempool] max_tx_bytes doesn't fatally disconnect honest
+        # peers gossiping legitimately large txs.
+        max_tx = getattr(self.mempool, "max_tx_bytes", 1_048_576)
+        return [
+            ChannelDescriptor(
+                MEMPOOL_CHANNEL, priority=5, send_queue_capacity=128,
+                recv_message_capacity=max_tx + MSG_OVERHEAD_BYTES, sheddable=True,
+            )
+        ]
 
     async def add_peer(self, peer) -> None:
         if self.broadcast:
@@ -58,6 +81,15 @@ class MempoolReactor(Reactor):
         self._peer_tasks.clear()
 
     async def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        if self.shed or self.mempool.is_full(0):
+            # overload/full: drop the whole batch BEFORE decoding it or
+            # paying the CheckTx round-trip (parsing a flood to count it
+            # would defeat the point) — gossiped txs are retried by the
+            # sender's walk, so a shed here costs latency, not delivery
+            self.shed_rx += 1  # messages (batches), not txs
+            if self.metrics is not None:
+                self.metrics.shed.labels("mempool_gossip").inc()
+            return
         loop = asyncio.get_running_loop()
         for tx in decode_txs(msg_bytes):
             # check_tx holds the mempool lock and calls the app synchronously;
@@ -73,6 +105,9 @@ class MempoolReactor(Reactor):
         sent: set = set()
         try:
             while True:
+                if self.shed:
+                    await asyncio.sleep(BROADCAST_SLEEP * 5)
+                    continue
                 entries = self.mempool.entries()
                 progress = False
                 for key, tx, senders in entries:

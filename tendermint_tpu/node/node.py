@@ -135,18 +135,29 @@ class Node:
 
         # ABCI app (4 logical connections); an external proxy_app address
         # selects the socket/grpc transport (reference: proxy/client.go)
+        remote_app = bool(config.base.proxy_app)
         if client_creator is None:
-            if config.base.proxy_app:
+            if remote_app:
                 from tendermint_tpu.proxy.multi import default_client_creator
 
                 client_creator = default_client_creator(
-                    config.base.proxy_app, config.base.abci
+                    config.base.proxy_app, config.base.abci,
+                    call_timeout=config.base.abci_call_timeout,
                 )
             else:
                 app = app or default_app(config.base.abci)
                 client_creator = local_client_creator(app)
         self.app = app
-        self.proxy_app = AppConns(client_creator)
+        # remote apps get reconnect-with-backoff on the non-consensus conns
+        # (an app restart must not crash the node); the consensus conn stays
+        # fatal-loud either way
+        self.proxy_app = AppConns(
+            client_creator,
+            resilient=remote_app,
+            attempts=config.base.abci_reconnect_attempts,
+            base_delay=config.base.abci_reconnect_base_delay,
+            max_delay=config.base.abci_reconnect_max_delay,
+        )
 
         # event bus + tx indexer
         self.event_bus = EventBus()
@@ -172,6 +183,11 @@ class Node:
                 if config.mempool.wal_dir and config.root_dir
                 else ""
             ),
+            max_tx_bytes=config.mempool.max_tx_bytes,
+            ttl_num_blocks=config.mempool.ttl_num_blocks,
+            ttl_seconds=config.mempool.ttl_seconds,
+            eviction=config.mempool.eviction,
+            max_txs_per_sender=config.mempool.max_txs_per_sender,
         )
 
         # evidence pool
@@ -220,10 +236,20 @@ class Node:
         self.prometheus_server = None
         self._running = False
 
+        # overload controller (node/overload.py): samples queue depths into
+        # a pressure level and flips the shed switches (mempool gossip, RPC
+        # gate, evidence walk) — never the vote path
+        from tendermint_tpu.node.overload import OverloadController
+
+        self.overload = OverloadController(
+            self, config.overload, metrics=self.metrics.overload
+        )
+
         # p2p (reference: node/node.go:754-793 createTransport/createSwitch)
         self.switch = None
         self.node_key = None
         self.consensus_reactor = None
+        self.mempool_reactor = None
         self.blocksync_reactor = None
         self.statesync_reactor = None
         self.addr_book = None
@@ -295,8 +321,19 @@ class Node:
                 if config.root_dir
                 else None
             )
+            recv_limit = None
+            if config.p2p.recv_rate_limit:
+                from tendermint_tpu.p2p.conn.connection import RecvRateLimit
+
+                recv_limit = RecvRateLimit(
+                    bytes_per_s=config.p2p.recv_rate_bytes_per_channel,
+                    msgs_per_s=config.p2p.recv_rate_msgs_per_channel,
+                    strikes=config.p2p.recv_rate_strikes,
+                    strike_window=config.p2p.recv_rate_strike_window,
+                )
             self.switch = Switch(
-                transport, metrics=self.metrics.p2p, trust_store_path=trust_path
+                transport, metrics=self.metrics.p2p, trust_store_path=trust_path,
+                recv_limit=recv_limit,
             )
             # fast sync is pointless when we are the only validator
             # (reference: node/node.go onlyValidatorIsUs)
@@ -311,7 +348,11 @@ class Node:
                 self.consensus, wait_sync=self.fast_sync or self.state_sync
             )
             self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
-            self.switch.add_reactor("MEMPOOL", MempoolReactor(self.mempool))
+            self.mempool_reactor = MempoolReactor(
+                self.mempool, broadcast=config.mempool.broadcast,
+                metrics=self.metrics.overload,
+            )
+            self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
             self.switch.add_reactor("EVIDENCE", EvidenceReactor(self.evidence_pool))
             from tendermint_tpu.blocksync.reactor import BlocksyncReactor
 
@@ -322,6 +363,8 @@ class Node:
                 consensus_reactor=self.consensus_reactor,
                 active=self.fast_sync and not self.state_sync,
                 metrics=self.metrics.blocksync,
+                peer_timeout=config.fastsync.peer_timeout,
+                retry_sleep=config.fastsync.retry_sleep,
             )
             self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
             from tendermint_tpu.statesync.reactor import StatesyncReactor
@@ -387,6 +430,8 @@ class Node:
             self._statesync_task = asyncio.create_task(
                 self._run_state_sync(), name="statesync"
             )
+        if self.config.overload.enabled:
+            self.overload.start()
         logger.info("node started (chain %s)", self.genesis.chain_id)
 
     async def _run_state_sync(self) -> None:
@@ -470,6 +515,7 @@ class Node:
 
     async def stop(self) -> None:
         self._running = False
+        await self.overload.stop()
         if self._statesync_task is not None:
             self._statesync_task.cancel()
         if self.rpc_server is not None:

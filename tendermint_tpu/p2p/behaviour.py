@@ -26,6 +26,7 @@ logger = logging.getLogger("tendermint_tpu.p2p")
 # behaviour kinds (reference: behaviour/peer_behaviour.go)
 BAD_MESSAGE = "bad_message"
 MESSAGE_OUT_OF_ORDER = "message_out_of_order"
+RATE_LIMIT = "rate_limit"  # persistent inbound flooding past the recv budget
 CONSENSUS_VOTE = "consensus_vote"
 BLOCK_PART = "block_part"
 

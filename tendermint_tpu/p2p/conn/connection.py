@@ -42,6 +42,63 @@ class ChannelDescriptor:
     priority: int = 1
     send_queue_capacity: int = 64
     recv_message_capacity: int = 22020096  # 21MB, reference default maxMsgSize
+    # Sheddable channels (mempool/pex/evidence) run their inbound messages
+    # through a per-peer token bucket; when the bucket is empty the message
+    # is dropped before reactor dispatch instead of backpressuring the whole
+    # connection. Consensus channels stay False: votes/proposals are NEVER
+    # rate-limited (the overload shed order is txs -> gossip -> never votes).
+    sheddable: bool = False
+
+
+@dataclass
+class RecvRateLimit:
+    """Per-channel inbound budget for sheddable channels ([p2p] recv_rate_*).
+
+    bytes_per_s / msgs_per_s of 0 disable that bucket. strikes/strike_window
+    bound how long a peer may flood before it is reported for misbehavior
+    (the switch routes the report to the trust scorer, which disconnects)."""
+
+    bytes_per_s: int = 1_048_576
+    msgs_per_s: int = 2000
+    strikes: int = 200
+    strike_window: float = 10.0
+
+
+class TokenBucket:
+    """Dual-rate (bytes/s + msgs/s) token bucket with a one-window burst cap
+    — idle time never banks unbounded credit (same policy as
+    libs/flowrate.Monitor.limit, but drop-based instead of sleep-based:
+    inbound shed must not stall the read loop that also carries votes)."""
+
+    __slots__ = ("bytes_per_s", "msgs_per_s", "_bytes", "_msgs", "_ts")
+
+    def __init__(self, bytes_per_s: int, msgs_per_s: int):
+        self.bytes_per_s = bytes_per_s
+        self.msgs_per_s = msgs_per_s
+        self._bytes = float(bytes_per_s)
+        self._msgs = float(msgs_per_s)
+        self._ts = time.monotonic()
+
+    def admit(self, nbytes: int) -> bool:
+        now = time.monotonic()
+        dt = now - self._ts
+        self._ts = now
+        if self.bytes_per_s > 0:
+            self._bytes = min(float(self.bytes_per_s), self._bytes + self.bytes_per_s * dt)
+        if self.msgs_per_s > 0:
+            self._msgs = min(float(self.msgs_per_s), self._msgs + self.msgs_per_s * dt)
+        # a message LARGER than one window's burst must still be admissible
+        # from a full bucket (a max-size tx on a budget == its own size would
+        # otherwise be permanently shed); the balance goes negative and the
+        # connection pays it back through refill time
+        need = min(float(nbytes), float(self.bytes_per_s))
+        ok = (self.bytes_per_s <= 0 or self._bytes >= need) and (
+            self.msgs_per_s <= 0 or self._msgs >= 1.0
+        )
+        if ok:
+            self._bytes -= nbytes
+            self._msgs -= 1.0
+        return ok
 
 
 @dataclass
@@ -90,6 +147,9 @@ class MConnection:
         on_error: Callable[[Exception], Awaitable[None]],
         send_rate: int = DEFAULT_SEND_RATE,
         recv_rate: int = DEFAULT_RECV_RATE,
+        recv_limit: Optional[RecvRateLimit] = None,
+        metrics=None,
+        on_rate_limit_exceeded: Optional[Callable[[], Awaitable[None]]] = None,
     ):
         self._t = transport
         self._channels: Dict[int, _Channel] = {
@@ -106,6 +166,23 @@ class MConnection:
         self._last_pong = time.monotonic()
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
+        # inbound admission control: one token bucket per SHEDDABLE channel
+        self.metrics = metrics  # P2PMetrics or None
+        self._recv_limit = recv_limit
+        self._recv_buckets: Dict[int, TokenBucket] = {}
+        if recv_limit is not None:
+            for d in channels:
+                if d.sheddable:
+                    self._recv_buckets[d.id] = TokenBucket(
+                        recv_limit.bytes_per_s, recv_limit.msgs_per_s
+                    )
+        self._on_rate_limit_exceeded = on_rate_limit_exceeded
+        self._shed_window_start = time.monotonic()
+        self._shed_in_window = 0
+        self.shed_msgs = 0  # total inbound messages dropped by the buckets
+        # chan_id -> dropped count; consensus channel ids must never appear
+        # here (pinned by the vote-path guard test)
+        self.shed_by_channel: Dict[int, int] = {}
 
     def start(self) -> None:
         self._tasks = [
@@ -160,6 +237,10 @@ class MConnection:
             "recv_rate_bytes": round(self._recv_monitor.status_rate(), 1),
             "send_bytes_total": self._send_monitor.total,
             "recv_bytes_total": self._recv_monitor.total,
+            "shed_msgs_total": self.shed_msgs,
+            "shed_by_channel": {
+                f"{cid:#x}": n for cid, n in self.shed_by_channel.items()
+            },
             "channels": [
                 {
                     "id": ch.desc.id,
@@ -270,11 +351,47 @@ class MConnection:
                     raise ValueError(f"unknown channel {chan_id}")
                 ch.recving += data
                 if len(ch.recving) > ch.desc.recv_message_capacity:
-                    raise ValueError("received message exceeds capacity")
+                    # per-channel assembled-message cap: reactors declare how
+                    # large a legitimate message on their channel can be; a
+                    # peer exceeding it is malformed or malicious and dies
+                    # loudly (counted first so the flood is visible)
+                    if self.metrics is not None:
+                        self.metrics.oversized_msgs.labels(f"{chan_id:#x}").inc()
+                    raise ValueError(
+                        f"message on channel {chan_id:#x} exceeds recv capacity "
+                        f"({len(ch.recving)} > {ch.desc.recv_message_capacity})"
+                    )
                 if eof:
                     msg = bytes(ch.recving)
                     ch.recving.clear()
+                    if not self._admit(chan_id, len(msg)):
+                        continue  # shed THIS frame only, not the envelope
                     await self._on_receive(chan_id, msg)
+
+    def _admit(self, chan_id: int, nbytes: int) -> bool:
+        """Inbound admission for sheddable channels: True = dispatch to the
+        reactor, False = drop. Channels without a bucket (consensus, or
+        limiting disabled) always admit."""
+        bucket = self._recv_buckets.get(chan_id)
+        if bucket is None or bucket.admit(nbytes):
+            return True
+        self.shed_msgs += 1
+        self.shed_by_channel[chan_id] = self.shed_by_channel.get(chan_id, 0) + 1
+        if self.metrics is not None:
+            self.metrics.rate_limited_msgs.labels(f"{chan_id:#x}").inc()
+        lim = self._recv_limit
+        now = time.monotonic()
+        if now - self._shed_window_start > lim.strike_window:
+            self._shed_window_start = now
+            self._shed_in_window = 0
+        self._shed_in_window += 1
+        if self._shed_in_window >= lim.strikes and self._on_rate_limit_exceeded is not None:
+            self._shed_in_window = 0
+            self._shed_window_start = now
+            # fire-and-forget: the report path may disconnect (and thereby
+            # cancel) this very receive loop — do not await it mid-packet
+            asyncio.get_running_loop().create_task(self._on_rate_limit_exceeded())
+        return False
 
     async def _ping_routine(self) -> None:
         try:

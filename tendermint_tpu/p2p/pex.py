@@ -308,7 +308,14 @@ class PexReactor(Reactor):
         self._task: Optional[asyncio.Task] = None
 
     def get_channels(self) -> List[ChannelDescriptor]:
-        return [ChannelDescriptor(PEX_CHANNEL, priority=1, send_queue_capacity=10)]
+        # sheddable + small capacity: a pex message is a bounded address
+        # list (reference: p2p/pex/pex_reactor.go maxMsgSize 64KB-ish)
+        return [
+            ChannelDescriptor(
+                PEX_CHANNEL, priority=1, send_queue_capacity=10,
+                recv_message_capacity=65536, sheddable=True,
+            )
+        ]
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._ensure_peers_routine(), name="pex-ensure")

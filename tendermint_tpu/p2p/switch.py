@@ -33,6 +33,7 @@ class Switch:
         max_peers: int = 50,
         metrics=None,
         trust_store_path: str | None = None,
+        recv_limit=None,
     ):
         from tendermint_tpu.p2p.behaviour import Reporter, TrustStore
 
@@ -62,6 +63,10 @@ class Switch:
         # can neither be dialed nor accepted (tendermint_tpu/chaos/harness.py
         # partitions an in-process net by installing group filters).
         self._conn_filter = None
+        # Inbound admission control (p2p/conn/connection.py RecvRateLimit):
+        # applied to every peer MConnection's sheddable channels. None
+        # disables per-channel rate limiting.
+        self.recv_limit = recv_limit
 
     @property
     def node_info(self):
@@ -235,7 +240,25 @@ class Switch:
         async def on_error(e: Exception) -> None:
             await self.stop_peer_for_error(peer_holder[0], e)
 
-        mconn = MConnection(conn.transport, self._channel_descs, on_receive, on_error)
+        async def on_rate_limit_exceeded() -> None:
+            # persistent flooding past the per-channel budgets: record bad
+            # conduct; repeated reports push the trust score under the
+            # threshold and the Reporter disconnects the peer
+            from tendermint_tpu.p2p.behaviour import RATE_LIMIT, PeerBehaviour
+
+            if self.metrics is not None:
+                self.metrics.rate_limit_disconnects.inc()
+            await self.reporter.report(
+                PeerBehaviour(
+                    peer_holder[0].id, RATE_LIMIT, "inbound recv budget exceeded"
+                )
+            )
+
+        mconn = MConnection(
+            conn.transport, self._channel_descs, on_receive, on_error,
+            recv_limit=self.recv_limit, metrics=self.metrics,
+            on_rate_limit_exceeded=on_rate_limit_exceeded,
+        )
         peer = Peer(ni, mconn, conn.outbound, persistent, conn.socket_addr,
                     metrics=self.metrics)
         peer_holder.append(peer)

@@ -328,10 +328,17 @@ class LocalClient:
         self._server = RPCServer(node) if node.rpc_server is None else node.rpc_server
 
     async def call(self, method: str, **params):
+        from tendermint_tpu.rpc.server import RPCShedError
+
         handler = self._server._routes.get(method)
         if handler is None:
             raise RPCError(-32601, f"method {method} not found")
-        return await handler(params)
+        try:
+            # through the load gate, same as the HTTP transports — a local
+            # client must not bypass the node's shed policy
+            return await self._server._dispatch(method, handler, params)
+        except RPCShedError:
+            raise RPCError(-32005, "server overloaded", method)
 
     def __getattr__(self, name):
         async def _proxy(**params):

@@ -15,6 +15,7 @@ from aiohttp import web, WSMsgType
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto import tmhash
 from tendermint_tpu.libs.pubsub import Query
+from tendermint_tpu.mempool.mempool import MempoolError
 from tendermint_tpu.types.event_bus import EVENT_TX, TX_HASH_KEY, query_for_event
 from tendermint_tpu.types.light import (
     block_id_to_json,
@@ -40,6 +41,74 @@ def _error(id_, code, message, data="") -> dict:
     return {"jsonrpc": "2.0", "id": id_, "error": {"code": code, "message": message, "data": data}}
 
 
+class RPCShedError(Exception):
+    """Raised by the load gate when a sheddable request is refused; the
+    transport layers translate it to HTTP 429 + Retry-After (JSON-RPC
+    error -32005)."""
+
+
+# JSON-RPC error codes (implementation-defined range)
+ERR_SHED = -32005  # server overloaded, retry later
+ERR_MEMPOOL = -32001  # mempool rejected the tx (data carries the reason)
+
+# Methods the gate may refuse under load. Everything else — health, status,
+# consensus introspection, net_info, the debug/unsafe routes — bypasses the
+# gate: an operator must be able to see INTO an overloaded node, and
+# consensus-critical paths are never shed.
+SHEDDABLE_METHODS = frozenset({
+    "broadcast_tx_async", "broadcast_tx_sync", "broadcast_tx_commit",
+    "check_tx", "abci_query", "abci_info",
+    "tx", "tx_search", "block_search",
+    "block", "blockchain", "block_results", "block_by_hash", "commit",
+    "unconfirmed_txs",
+})
+# Under overload pressure (node/overload.py flips rpc_shed_writes before
+# rpc_shed_reads), write-path methods shed first.
+WRITE_METHODS = frozenset(
+    {"broadcast_tx_async", "broadcast_tx_sync", "broadcast_tx_commit"}
+)
+
+
+class LoadGate:
+    """Bounded-concurrency admission gate for sheddable RPC methods
+    ([rpc] max_inflight_requests). Refusal is immediate (no queueing): an
+    overloaded serving stack must fail fast with Retry-After, not build an
+    unbounded backlog. The overload controller may additionally force-shed
+    writes (shed_writes) or all sheddable methods (shed_reads)."""
+
+    def __init__(self, max_inflight: int, metrics=None):
+        self.max_inflight = max_inflight
+        self.metrics = metrics  # RPCMetrics or None
+        self.inflight = 0
+        self.shed_total = 0
+        self.shed_writes = False  # flipped by the overload controller
+        self.shed_reads = False
+
+    def admits(self, method: str) -> bool:
+        if method not in SHEDDABLE_METHODS:
+            return True
+        if self.shed_reads:
+            return False
+        if self.shed_writes and method in WRITE_METHODS:
+            return False
+        return self.max_inflight <= 0 or self.inflight < self.max_inflight
+
+    def record_shed(self, method: str) -> None:
+        self.shed_total += 1
+        if self.metrics is not None:
+            self.metrics.shed_requests.labels(method).inc()
+
+    def enter(self) -> None:
+        self.inflight += 1
+        if self.metrics is not None:
+            self.metrics.inflight_requests.set(self.inflight)
+
+    def exit(self) -> None:
+        self.inflight -= 1
+        if self.metrics is not None:
+            self.metrics.inflight_requests.set(self.inflight)
+
+
 class RPCServer:
     def __init__(self, node):
         self.node = node
@@ -58,8 +127,17 @@ class RPCServer:
         self.app.router.add_get(
             "/debug/consensus_timeline", self._handle_debug_consensus_timeline
         )
+        self.app.router.add_get("/debug/overload", self._handle_debug_overload)
         self.app.router.add_get("/{method}", self._handle_uri)
         self.runner: Optional[web.AppRunner] = None
+        # load-shedding gate ([rpc] max_inflight_requests); the overload
+        # controller (node/overload.py) reads inflight and flips the
+        # shed_writes/shed_reads switches
+        rpc_metrics = getattr(getattr(node, "metrics", None), "rpc", None)
+        self.gate = LoadGate(
+            getattr(node.config.rpc, "max_inflight_requests", 0),
+            metrics=rpc_metrics,
+        )
         self._routes = {
             "health": self._health,
             "status": self._status,
@@ -94,7 +172,46 @@ class RPCServer:
             "debug_trace": self._debug_trace,
             "debug_verify_stats": self._debug_verify_stats,
             "consensus_timeline": self._consensus_timeline,
+            "debug_overload": self._debug_overload,
         }
+
+    # -- load shedding -------------------------------------------------------
+
+    async def _dispatch(self, method: str, handler, params):
+        """All transports (JSON-RPC POST, URI GET, websocket) route through
+        the gate here; a refused request raises RPCShedError for the
+        transport to translate (HTTP 429 + Retry-After)."""
+        if not self.gate.admits(method):
+            self.gate.record_shed(method)
+            raise RPCShedError(method)
+        if method not in SHEDDABLE_METHODS:
+            return await handler(params)
+        self.gate.enter()
+        try:
+            return await handler(params)
+        finally:
+            self.gate.exit()
+
+    def _shed_response(self, id_, method: str) -> web.Response:
+        retry_after = getattr(self.node.config.rpc, "shed_retry_after", 1.0)
+        return web.json_response(
+            _error(
+                id_, ERR_SHED, "server overloaded",
+                {"method": method, "retry_after": retry_after},
+            ),
+            status=429,
+            headers={"Retry-After": f"{retry_after:g}"},
+        )
+
+    @staticmethod
+    def _mempool_reject(id_, e) -> dict:
+        """Structured JSON-RPC error for a mempool admission rejection —
+        the reject reason (full/evicted/cache/quota/too_large) is data, not
+        a 500 with a bare traceback."""
+        return _error(
+            id_, ERR_MEMPOOL, "mempool rejected tx",
+            {"reason": getattr(e, "reason", "rejected"), "detail": str(e)},
+        )
 
     async def start(self) -> None:
         self.runner = web.AppRunner(self.app)
@@ -121,8 +238,12 @@ class RPCServer:
         if handler is None:
             return web.json_response(_error(id_, -32601, f"method {method} not found"))
         try:
-            result = await handler(params)
+            result = await self._dispatch(method, handler, params)
             return web.json_response(_result(id_, result))
+        except RPCShedError:
+            return self._shed_response(id_, method)
+        except MempoolError as e:
+            return web.json_response(self._mempool_reject(id_, e))
         except Exception as e:
             logger.exception("rpc error in %s", method)
             return web.json_response(_error(id_, -32603, "internal error", str(e)))
@@ -160,6 +281,12 @@ class RPCServer:
         except Exception as e:
             return web.json_response(_error(None, -32603, "internal error", str(e)))
 
+    async def _handle_debug_overload(self, request: web.Request) -> web.Response:
+        try:
+            return web.json_response(_result(None, await self._debug_overload({})))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
+
     async def _handle_uri(self, request: web.Request) -> web.Response:
         method = request.match_info["method"]
         handler = self._routes.get(method)
@@ -167,8 +294,12 @@ class RPCServer:
             return web.json_response(_error(None, -32601, f"method {method} not found"))
         params = {k: v.strip('"') for k, v in request.query.items()}
         try:
-            result = await handler(params)
+            result = await self._dispatch(method, handler, params)
             return web.json_response(_result(None, result))
+        except RPCShedError:
+            return self._shed_response(None, method)
+        except MempoolError as e:
+            return web.json_response(self._mempool_reject(None, e))
         except Exception as e:
             return web.json_response(_error(None, -32603, "internal error", str(e)))
 
@@ -234,7 +365,15 @@ class RPCServer:
                         await ws.send_json(_error(id_, -32601, f"method {method} not found"))
                     else:
                         try:
-                            await ws.send_json(_result(id_, await handler(params)))
+                            await ws.send_json(
+                                _result(id_, await self._dispatch(method, handler, params))
+                            )
+                        except RPCShedError:
+                            await ws.send_json(
+                                _error(id_, ERR_SHED, "server overloaded", {"method": method})
+                            )
+                        except MempoolError as e:
+                            await ws.send_json(self._mempool_reject(id_, e))
                         except Exception as e:
                             await ws.send_json(_error(id_, -32603, "internal error", str(e)))
         finally:
@@ -797,6 +936,51 @@ class RPCServer:
             "count": len(heights),
             "heights": heights,
         }
+
+    async def _debug_overload(self, params) -> dict:
+        """Overload-protection snapshot (node/overload.py + the RPC gate +
+        mempool admission + per-peer shed counters): the one page an
+        operator reads when the node is under pressure. Read-only, served
+        regardless of rpc.unsafe (like /debug/verify_stats)."""
+        out = {
+            "rpc": {
+                "max_inflight_requests": self.gate.max_inflight,
+                "inflight": self.gate.inflight,
+                "shed_total": self.gate.shed_total,
+                "shed_writes": self.gate.shed_writes,
+                "shed_reads": self.gate.shed_reads,
+            }
+        }
+        ctl = getattr(self.node, "overload", None)
+        out["controller"] = ctl.snapshot() if ctl is not None else None
+        mp = getattr(self.node, "mempool", None)
+        if mp is not None:
+            out["mempool"] = {
+                "size": mp.size(),
+                "max_txs": mp.max_txs,
+                "bytes": mp.txs_bytes(),
+                "max_bytes": mp.max_txs_bytes,
+                "full": mp.is_full(0),
+                "evicted_total": getattr(mp, "evicted_total", 0),
+                "expired_total": getattr(mp, "expired_total", 0),
+            }
+        sw = getattr(self.node, "switch", None)
+        if sw is not None:
+            out["p2p"] = {
+                "peers": sw.num_peers(),
+                "shed_by_peer": {
+                    p.id[:10]: {
+                        "shed_msgs_total": p.mconn.shed_msgs,
+                        "by_channel": {
+                            f"{cid:#x}": n
+                            for cid, n in p.mconn.shed_by_channel.items()
+                        },
+                    }
+                    for p in sw.peers.list()
+                    if p.mconn.shed_msgs
+                },
+            }
+        return out
 
     async def _dial_peers(self, params) -> dict:
         """unsafe route (reference: rpc/core/net.go UnsafeDialPeers)."""

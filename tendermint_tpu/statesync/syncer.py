@@ -20,7 +20,9 @@ from tendermint_tpu.types.block import Commit
 
 logger = logging.getLogger("tendermint_tpu.statesync")
 
-# reference: statesync/syncer.go:21-35
+# reference: statesync/syncer.go:21-35. CHUNK_TIMEOUT is only the
+# no-config default: the node path passes [statesync] chunk_request_timeout
+# through StatesyncReactor.sync (node/node.py _run_state_sync).
 CHUNK_TIMEOUT = 2 * 60.0
 MIN_SNAPSHOT_PEERS = 1
 

@@ -1,0 +1,322 @@
+"""Mempool admission control under flood (ISSUE 5 satellite): eviction
+ordering, TTL purge on update, per-sender quotas, cache interaction on
+evicted txs, and WAL replay after eviction. Pure-host tests — no crypto
+wheel, no TPU, no p2p."""
+
+import os
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import ABCIClient
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.libs import metrics as M
+from tendermint_tpu.mempool.mempool import (
+    Mempool,
+    MempoolFullError,
+    SenderQuotaError,
+    TxInCacheError,
+    TxTooLargeError,
+    iter_mempool_wal,
+)
+
+
+class PrioApp(ABCIClient):
+    """CheckTx stub: a tx like b'p7:payload' gets priority 7; everything is
+    accepted unless it starts with b'bad'."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def check_tx(self, req):
+        self.calls += 1
+        tx = req.tx
+        prio = 0
+        if tx.startswith(b"p") and b":" in tx:
+            try:
+                prio = int(tx[1 : tx.index(b":")])
+            except ValueError:
+                prio = 0
+        code = abci.CODE_TYPE_OK if not tx.startswith(b"bad") else 1
+        return abci.ResponseCheckTx(code=code, priority=prio)
+
+
+def make_pool(**kw):
+    reg = M.Registry()
+    mm = M.MempoolMetrics(reg)
+    defaults = dict(max_txs=3, metrics=mm)
+    defaults.update(kw)
+    return Mempool(PrioApp(), **defaults), mm
+
+
+def txs_in(mp):
+    return [m.tx for m in mp._txs.values()]
+
+
+# ---------------------------------------------------------------------------
+# eviction
+
+
+def test_eviction_evicts_lowest_priority_first():
+    mp, mm = make_pool()
+    for tx in (b"p5:a", b"p1:b", b"p3:c"):
+        mp.check_tx(tx)
+    assert mp.is_full(0)
+    mp.check_tx(b"p4:d")  # displaces the priority-1 resident
+    assert txs_in(mp) == [b"p5:a", b"p3:c", b"p4:d"]
+    assert mp.evicted_total == 1
+    assert mm.evicted_txs._values.get((), 0) == 1
+
+
+def test_eviction_equal_priority_is_lru_oldest_first():
+    mp, _ = make_pool()
+    for tx in (b"p0:a", b"p0:b", b"p0:c"):
+        mp.check_tx(tx)
+    mp.check_tx(b"p0:d")
+    assert txs_in(mp) == [b"p0:b", b"p0:c", b"p0:d"]
+
+
+def test_eviction_refuses_when_only_higher_priority_remains():
+    mp, mm = make_pool()
+    for tx in (b"p5:a", b"p5:b", b"p5:c"):
+        mp.check_tx(tx)
+    with pytest.raises(MempoolFullError) as ei:
+        mp.check_tx(b"p1:low")
+    assert ei.value.reason == "full"
+    assert txs_in(mp) == [b"p5:a", b"p5:b", b"p5:c"]
+    assert mm.rejected_txs._values.get(("full",), 0) == 1
+    # the refused arrival was UN-cached: once the pool drains it may re-enter
+    mp.flush()
+    assert mp.check_tx(b"p1:low").code == abci.CODE_TYPE_OK
+    assert txs_in(mp) == [b"p1:low"]
+
+
+def test_eviction_frees_bytes_not_just_slots():
+    mp, _ = make_pool(max_txs=100, max_txs_bytes=30)
+    mp.check_tx(b"p0:" + b"a" * 10)  # 13 bytes
+    mp.check_tx(b"p0:" + b"b" * 10)
+    assert mp.txs_bytes() == 26
+    mp.check_tx(b"p0:" + b"c" * 20)  # 23 bytes: must evict BOTH residents
+    assert txs_in(mp) == [b"p0:" + b"c" * 20]
+    assert mp.txs_bytes() == 23
+
+
+def test_eviction_disabled_restores_hard_error():
+    mp, _ = make_pool(eviction=False)
+    for tx in (b"a", b"b", b"c"):
+        mp.check_tx(tx)
+    with pytest.raises(MempoolFullError):
+        mp.check_tx(b"d")
+    # gossiped txs drop silently
+    assert mp.check_tx(b"e", sender="peer1") is None
+
+
+def test_evicted_tx_leaves_cache_and_can_return():
+    mp, _ = make_pool()
+    for tx in (b"p0:a", b"p0:b", b"p0:c"):
+        mp.check_tx(tx)
+    mp.check_tx(b"p9:big")  # evicts p0:a
+    assert b"p0:a" not in txs_in(mp)
+    # a fresh submission of the evicted tx is admitted (would raise
+    # TxInCacheError if eviction left the hash poisoned in the cache)
+    mp.check_tx(b"p0:a")
+    assert b"p0:a" in txs_in(mp)
+
+
+def test_duplicate_of_resident_tx_never_triggers_eviction():
+    """A duplicate whose hash churned out of the dedup cache (the cache also
+    holds rejected hashes, so it cycles under flood) must not evict innocent
+    residents just to insert nothing."""
+    mp, _ = make_pool()
+    for tx in (b"p0:a", b"p0:b", b"p9:c"):
+        mp.check_tx(tx)
+    key = tmhash.sum256(b"p9:c")
+    mp._cache.pop(key)  # simulate cache churn: resident but forgotten
+    mp.check_tx(b"p9:c")  # duplicate passes the cache, pool is full
+    assert txs_in(mp) == [b"p0:a", b"p0:b", b"p9:c"]  # nothing evicted
+    assert mp.evicted_total == 0
+
+
+# ---------------------------------------------------------------------------
+# TTL
+
+
+def test_ttl_num_blocks_purges_on_update():
+    mp, mm = make_pool(max_txs=100, ttl_num_blocks=2)
+    mp.update(10, [], [])  # pool height now 10
+    mp.check_tx(b"p0:old")  # admitted at height 10
+    mp.update(11, [], [])
+    assert b"p0:old" in txs_in(mp)  # age 1 < 2
+    mp.update(12, [], [])
+    assert b"p0:old" not in txs_in(mp)  # age 2 >= 2: purged
+    assert mp.expired_total == 1
+    assert mm.expired_txs._values.get((), 0) == 1
+    # un-cached on expiry: resubmission is accepted
+    mp.check_tx(b"p0:old")
+    assert b"p0:old" in txs_in(mp)
+
+
+def test_ttl_seconds_purges_on_update():
+    mp, _ = make_pool(max_txs=100, ttl_seconds=0.5)
+    mp.check_tx(b"p0:young")
+    # backdate the admission timestamp past the TTL
+    next(iter(mp._txs.values())).time_ns -= int(1e9)
+    mp.update(1, [], [])
+    assert txs_in(mp) == []
+    assert mp.expired_total == 1
+
+
+# ---------------------------------------------------------------------------
+# per-sender quota
+
+
+def test_sender_quota_limits_gossip_but_not_rpc():
+    mp, mm = make_pool(max_txs=100, max_txs_per_sender=2)
+    assert mp.check_tx(b"p0:a", sender="peerA") is not None
+    assert mp.check_tx(b"p0:b", sender="peerA") is not None
+    # third gossiped tx from the same peer: dropped silently, counted
+    assert mp.check_tx(b"p0:c", sender="peerA") is None
+    assert mm.rejected_txs._values.get(("quota",), 0) == 1
+    assert b"p0:c" not in txs_in(mp)
+    # another peer and local RPC submissions are unaffected
+    assert mp.check_tx(b"p0:d", sender="peerB") is not None
+    for i in range(5):
+        mp.check_tx(b"p0:rpc%d" % i)
+    assert mp.size() == 8
+
+
+def test_sender_quota_freed_by_commit_and_eviction():
+    mp, _ = make_pool(max_txs=2, max_txs_per_sender=2)
+    mp.check_tx(b"p0:a", sender="peerA")
+    mp.check_tx(b"p0:b", sender="peerA")
+    assert mp._sender_counts == {"peerA": 2}
+    # commit one: quota slot returns
+    mp.update(1, [b"p0:a"], [abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)])
+    assert mp._sender_counts == {"peerA": 1}
+    assert mp.check_tx(b"p0:c", sender="peerA") is not None
+    # eviction also releases the victim's quota slot
+    mp.check_tx(b"p9:hi")  # evicts oldest p0 from peerA
+    assert mp._sender_counts.get("peerA", 0) == 1
+
+
+def test_sender_quota_raises_for_local_flood_only_when_sender_set():
+    mp, _ = make_pool(max_txs=100, max_txs_per_sender=1)
+    mp.check_tx(b"p0:a", sender="peerA")
+    assert mp.check_tx(b"p0:b", sender="peerA") is None
+    err = SenderQuotaError("peerA", 1)
+    assert err.reason == "quota"
+
+
+# ---------------------------------------------------------------------------
+# size cap / structured reasons
+
+
+def test_too_large_rejected_with_reason():
+    mp, mm = make_pool(max_txs=100, max_tx_bytes=8)
+    with pytest.raises(TxTooLargeError) as ei:
+        mp.check_tx(b"0123456789")
+    assert ei.value.reason == "too_large"
+    assert mm.rejected_txs._values.get(("too_large",), 0) == 1
+    assert mp.check_tx(b"0123456789", sender="p") is None  # gossip: silent
+
+
+def test_cache_reject_reason():
+    mp, mm = make_pool(max_txs=100)
+    mp.check_tx(b"p0:a")
+    with pytest.raises(TxInCacheError) as ei:
+        mp.check_tx(b"p0:a")
+    assert ei.value.reason == "cache"
+    assert mm.rejected_txs._values.get(("cache",), 0) == 1
+
+
+def test_full_gauge_tracks_capacity():
+    mp, mm = make_pool()
+    for tx in (b"a", b"b"):
+        mp.check_tx(tx)
+    assert mm.full._values.get((), 0) == 0
+    mp.check_tx(b"c")
+    assert mm.full._values.get((), 0) == 1
+    mp.update(1, [b"a"], [abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)])
+    assert mm.full._values.get((), 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# WAL replay after eviction
+
+
+def test_wal_replay_readmits_evicted_tx(tmp_path):
+    wal = str(tmp_path / "mempool" / "wal")
+    mp, _ = make_pool(wal_path=wal)
+    for tx in (b"p0:a", b"p0:b", b"p0:c"):
+        mp.check_tx(tx)
+    mp.check_tx(b"p9:vip")  # evicts p0:a; WAL has all four admissions
+    assert b"p0:a" not in txs_in(mp)
+    mp.close_wal()
+    recorded = list(iter_mempool_wal(wal))
+    assert recorded == [b"p0:a", b"p0:b", b"p0:c", b"p9:vip"]
+
+    # fresh pool (post-crash): replay re-admits the survivors in WAL order,
+    # INCLUDING the evicted tx — eviction un-cached it, so nothing blocks it
+    mp2, _ = make_pool(max_txs=10)
+    accepted = mp2.replay_wal(wal)
+    assert accepted == 4
+    assert b"p0:a" in txs_in(mp2)
+
+
+def test_wal_replay_does_not_append_to_its_own_wal(tmp_path):
+    """Replaying into a pool whose live WAL is the same file must not write
+    the re-admissions back (the file would double per replay cycle)."""
+    wal = str(tmp_path / "self" / "wal")
+    mp, _ = make_pool(wal_path=wal, max_txs=10)
+    for tx in (b"p0:a", b"p0:b"):
+        mp.check_tx(tx)
+    mp.flush()  # crash-ish: pool empty, WAL keeps the admissions
+    assert mp.replay_wal(wal) == 2
+    assert list(iter_mempool_wal(wal)) == [b"p0:a", b"p0:b"]  # unchanged
+    # the live WAL is restored after replay: new admissions still append
+    mp.check_tx(b"p0:new")
+    mp.close_wal()
+    assert list(iter_mempool_wal(wal)) == [b"p0:a", b"p0:b", b"p0:new"]
+
+
+def test_wal_replay_stops_at_torn_tail(tmp_path):
+    wal = str(tmp_path / "m" / "wal")
+    mp, _ = make_pool(wal_path=wal, max_txs=10)
+    for tx in (b"p0:a", b"p0:b"):
+        mp.check_tx(tx)
+    mp.close_wal()
+    with open(wal, "ab") as f:  # torn record: length prefix, half a tx
+        f.write((8).to_bytes(4, "big") + b"xxx")
+    assert list(iter_mempool_wal(wal)) == [b"p0:a", b"p0:b"]
+
+
+# ---------------------------------------------------------------------------
+# invariants under mixed churn
+
+
+def test_byte_accounting_stays_consistent_under_churn():
+    mp, _ = make_pool(max_txs=4, max_txs_bytes=200, ttl_num_blocks=3,
+                      max_txs_per_sender=3)
+    import random
+
+    rng = random.Random(7)
+    for step in range(200):
+        tx = b"p%d:%d" % (rng.randrange(4), step)
+        sender = rng.choice(["", "peerA", "peerB"])
+        try:
+            mp.check_tx(tx, sender=sender)
+        except Exception:
+            pass
+        if step % 13 == 0:
+            committed = txs_in(mp)[:1]
+            mp.update(
+                step // 13,
+                committed,
+                [abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)] * len(committed),
+            )
+        assert mp.txs_bytes() == sum(len(t) for t in txs_in(mp))
+        assert mp.size() <= 4
+        assert all(n > 0 for n in mp._sender_counts.values())
+        assert sum(mp._sender_counts.values()) <= mp.size()

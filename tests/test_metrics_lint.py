@@ -48,6 +48,18 @@ def _populated_node_metrics() -> M.NodeMetrics:
     nm.blocksync.syncing.set(1)
     nm.blocksync.verify_seconds.observe(0.1)
     nm.statesync.chunks_applied_total.inc()
+    # overload-protection series (ISSUE 5)
+    nm.mempool.evicted_txs.inc(2)
+    nm.mempool.rejected_txs.labels("quota").inc()
+    nm.mempool.full.set(1)
+    nm.p2p.rate_limited_msgs.labels("0x30").inc(5)
+    nm.p2p.oversized_msgs.labels("0x30").inc()
+    nm.rpc.inflight_requests.set(3)
+    nm.rpc.shed_requests.labels("broadcast_tx_sync").inc()
+    nm.overload.pressure_level.set(1)
+    nm.overload.pressure.labels("mempool").set(0.8)
+    nm.overload.transitions.labels("up").inc()
+    nm.blocksync.peer_timeouts.inc()
     return nm
 
 
@@ -154,6 +166,8 @@ METRICS_SETS = (
     M.StateMetrics,
     M.BlockSyncMetrics,
     M.StateSyncMetrics,
+    M.RPCMetrics,
+    M.OverloadMetrics,
     M.BatchVerifyMetrics,
     M.PubSubMetrics,
     M.ChaosMetrics,

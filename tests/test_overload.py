@@ -1,0 +1,670 @@
+"""Overload-protection unit/integration coverage (ISSUE 5): the p2p inbound
+token buckets (votes NEVER shed — the vote-path guard), per-channel recv
+capacity, the RPC load gate + structured mempool errors + 429s, the node
+overload controller's pressure machine, and ABCI reconnect-with-backoff
+through an app restart. Runs without the `cryptography` wheel or TPUs."""
+
+import asyncio
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.config.config import test_config
+from tendermint_tpu.libs import metrics as M
+from tendermint_tpu.libs import protowire as pw
+from tendermint_tpu.p2p.conn.connection import (
+    ChannelDescriptor,
+    MConnection,
+    RecvRateLimit,
+    TokenBucket,
+)
+
+VOTE_CH = 0x22
+MEMPOOL_CH = 0x30
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+
+
+def test_token_bucket_burst_then_refuse():
+    tb = TokenBucket(bytes_per_s=100, msgs_per_s=0)
+    assert tb.admit(60)
+    assert not tb.admit(60)  # only ~40 credit left
+    assert tb.admit(30)
+
+
+def test_token_bucket_msg_budget():
+    tb = TokenBucket(bytes_per_s=0, msgs_per_s=2)
+    assert tb.admit(1)
+    assert tb.admit(1)
+    assert not tb.admit(1)
+
+
+def test_token_bucket_refills_but_never_banks_past_one_window():
+    tb = TokenBucket(bytes_per_s=1000, msgs_per_s=0)
+    assert tb.admit(1000)
+    assert not tb.admit(10)
+    time.sleep(0.05)  # ~50 tokens back
+    assert tb.admit(20)
+    # idle "forever": credit caps at one window's worth
+    tb._ts -= 3600.0
+    assert tb.admit(1000)
+    assert not tb.admit(200)
+
+
+def test_token_bucket_admits_message_larger_than_burst():
+    """A message bigger than one second of byte budget must still pass from
+    a full bucket (else a max-size tx on a budget == its own size is
+    PERMANENTLY inadmissible); the balance goes negative and subsequent
+    messages are shed until refill pays it back."""
+    tb = TokenBucket(bytes_per_s=1000, msgs_per_s=0)
+    assert tb.admit(5000)  # full bucket: oversize admitted
+    assert not tb.admit(10)  # deep in debt now
+    tb._ts -= 10.0  # refill time elapses (credit caps at one window)
+    assert tb.admit(10)
+
+
+def test_token_bucket_zero_rates_disable():
+    tb = TokenBucket(bytes_per_s=0, msgs_per_s=0)
+    for _ in range(1000):
+        assert tb.admit(1 << 20)
+
+
+# ---------------------------------------------------------------------------
+# MConnection shed path
+
+
+class _NullTransport:
+    async def write(self, data):
+        pass
+
+    async def read(self, n):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+def _packet_env(chan_id: int, data: bytes) -> bytes:
+    body = pw.Writer()
+    body.varint_field(1, chan_id)
+    body.varint_field(2, 1)  # eof: whole message in one packet
+    body.bytes_field(3, data, emit_empty=True)
+    env = pw.Writer()
+    env.message_field(3, body.bytes(), always=True)
+    return env.bytes()
+
+
+def _mconn(limit, metrics=None, on_exceeded=None):
+    received = []
+
+    async def on_receive(chan_id, msg):
+        received.append((chan_id, msg))
+
+    async def on_error(e):
+        raise AssertionError(f"on_error: {e}")
+
+    chans = [
+        ChannelDescriptor(VOTE_CH, priority=7),
+        ChannelDescriptor(MEMPOOL_CH, priority=5, sheddable=True,
+                          recv_message_capacity=1024),
+    ]
+    conn = MConnection(
+        _NullTransport(), chans, on_receive, on_error,
+        recv_limit=limit, metrics=metrics,
+        on_rate_limit_exceeded=on_exceeded,
+    )
+    return conn, received
+
+
+def test_vote_channel_never_shed_while_mempool_floods():
+    """THE vote-path guard: with the mempool channel saturated far past its
+    budget, every vote-channel message still dispatches and the shed
+    accounting shows zero drops on consensus channels."""
+    reg = M.Registry()
+    pm = M.P2PMetrics(reg)
+    limit = RecvRateLimit(bytes_per_s=0, msgs_per_s=5, strikes=10 ** 9)
+    conn, received = _mconn(limit, metrics=pm)
+
+    async def run():
+        for i in range(200):
+            await conn._handle_packet(_packet_env(MEMPOOL_CH, b"tx%03d" % i))
+            await conn._handle_packet(_packet_env(VOTE_CH, b"vote%03d" % i))
+
+    asyncio.run(run())
+    votes = [m for c, m in received if c == VOTE_CH]
+    txs = [m for c, m in received if c == MEMPOOL_CH]
+    assert len(votes) == 200  # zero votes dropped
+    assert len(txs) <= 6  # bucket: 5 + at most one refill tick
+    assert conn.shed_msgs == 200 - len(txs)
+    assert VOTE_CH not in conn.shed_by_channel
+    assert conn.shed_by_channel[MEMPOOL_CH] == conn.shed_msgs
+    # counters: only the mempool channel appears
+    assert pm.rate_limited_msgs._values.get(("0x30",), 0) == conn.shed_msgs
+    assert pm.rate_limited_msgs._values.get(("0x22",), 0) == 0
+    # status() surfaces the shed accounting for net_info//debug/overload
+    st = conn.status()
+    assert st["shed_msgs_total"] == conn.shed_msgs
+    assert st["shed_by_channel"] == {"0x30": conn.shed_msgs}
+
+
+def test_persistent_flooder_triggers_misbehavior_callback():
+    fired = asyncio.Event()
+
+    async def on_exceeded():
+        fired.set()
+
+    limit = RecvRateLimit(bytes_per_s=0, msgs_per_s=1, strikes=5,
+                          strike_window=60.0)
+    conn, _ = _mconn(limit, on_exceeded=on_exceeded)
+
+    async def run():
+        for i in range(10):
+            await conn._handle_packet(_packet_env(MEMPOOL_CH, b"x"))
+        await asyncio.sleep(0)  # let the fire-and-forget report task run
+        assert fired.is_set()
+
+    asyncio.run(run())
+
+
+def test_no_limit_config_admits_everything():
+    conn, received = _mconn(None)
+
+    async def run():
+        for i in range(50):
+            await conn._handle_packet(_packet_env(MEMPOOL_CH, b"x"))
+
+    asyncio.run(run())
+    assert len(received) == 50
+    assert conn.shed_msgs == 0
+
+
+def test_oversized_message_counted_and_fatal():
+    reg = M.Registry()
+    pm = M.P2PMetrics(reg)
+    conn, _ = _mconn(None, metrics=pm)
+
+    async def run():
+        with pytest.raises(ValueError, match="exceeds recv capacity"):
+            await conn._handle_packet(_packet_env(MEMPOOL_CH, b"z" * 2048))
+
+    asyncio.run(run())
+    assert pm.oversized_msgs._values.get(("0x30",), 0) == 1
+
+
+def test_reactor_channel_shed_policy():
+    """Consensus channels must never be sheddable; mempool/pex/evidence must
+    be — the shed ORDER (txs, gossip, never votes) is a declared invariant,
+    not an emergent one."""
+    from tendermint_tpu.consensus.reactor import ConsensusReactor
+    from tendermint_tpu.evidence.reactor import EvidenceReactor
+    from tendermint_tpu.mempool.reactor import MempoolReactor
+    from tendermint_tpu.p2p.pex import AddrBook, PexReactor
+
+    cons = ConsensusReactor.__new__(ConsensusReactor)
+    for d in ConsensusReactor.get_channels(cons):
+        assert not d.sheddable, f"consensus channel {d.id:#x} marked sheddable"
+        assert d.recv_message_capacity <= 22020096
+    for d in MempoolReactor(None).get_channels():
+        assert d.sheddable
+    for d in EvidenceReactor(None).get_channels():
+        assert d.sheddable
+    for d in PexReactor(AddrBook(None)).get_channels():
+        assert d.sheddable
+
+
+# ---------------------------------------------------------------------------
+# RPC load gate
+
+
+def _gate(max_inflight=2):
+    reg = M.Registry()
+    rm = M.RPCMetrics(reg)
+    from tendermint_tpu.rpc.server import LoadGate
+
+    return LoadGate(max_inflight, metrics=rm), rm
+
+
+def test_gate_bounds_sheddable_only():
+    gate, _ = _gate(2)
+    assert gate.admits("broadcast_tx_sync")
+    gate.enter()
+    gate.enter()
+    assert not gate.admits("broadcast_tx_sync")
+    assert not gate.admits("abci_query")
+    # non-sheddable methods bypass a full gate
+    for m in ("health", "status", "consensus_state", "net_info",
+              "debug_overload", "broadcast_evidence"):
+        assert gate.admits(m)
+    gate.exit()
+    assert gate.admits("broadcast_tx_sync")
+
+
+def test_gate_overload_switches_shed_writes_then_reads():
+    gate, rm = _gate(100)
+    gate.shed_writes = True
+    assert not gate.admits("broadcast_tx_commit")
+    assert gate.admits("abci_query")  # reads still served at ELEVATED
+    gate.shed_reads = True
+    assert not gate.admits("abci_query")
+    assert gate.admits("status")  # never shed
+    gate.record_shed("broadcast_tx_commit")
+    assert gate.shed_total == 1
+    assert rm.shed_requests._values.get(("broadcast_tx_commit",), 0) == 1
+
+
+class _FakeRequest:
+    def __init__(self, body):
+        self._body = body
+        self.query = {}
+
+    async def json(self):
+        return self._body
+
+
+def _rpc_server(mempool=None, max_inflight=2):
+    from tendermint_tpu.rpc.server import RPCServer
+
+    cfg = test_config()
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.max_inflight_requests = max_inflight
+    node = SimpleNamespace(
+        config=cfg, metrics=M.NodeMetrics(), mempool=mempool,
+        rpc_server=None, switch=None, overload=None,
+    )
+    return RPCServer(node)
+
+
+def test_rpc_429_with_retry_after_when_gate_full():
+    import json as _json
+
+    rpc = _rpc_server()
+    rpc.gate.enter()
+    rpc.gate.enter()  # gate saturated
+
+    async def run():
+        resp = await rpc._handle_jsonrpc(
+            _FakeRequest({"id": 1, "method": "broadcast_tx_sync",
+                          "params": {"tx": "00"}})
+        )
+        assert resp.status == 429
+        assert resp.headers["Retry-After"]
+        body = _json.loads(resp.text)
+        assert body["error"]["code"] == -32005
+        assert body["error"]["data"]["method"] == "broadcast_tx_sync"
+        # health bypasses the saturated gate
+        ok = await rpc._handle_jsonrpc(_FakeRequest({"id": 2, "method": "health"}))
+        assert ok.status == 200
+        # shed accounting fed the metrics
+        assert rpc.gate.shed_total == 1
+
+    asyncio.run(run())
+
+
+def test_rpc_structured_mempool_reject_not_500():
+    """broadcast_tx_sync against a full/quota'd mempool returns a typed
+    JSON-RPC error carrying the reject reason — not -32603 with a bare
+    traceback string."""
+    import json as _json
+
+    from tendermint_tpu.mempool.mempool import MempoolFullError, SenderQuotaError
+
+    class RejectingMempool:
+        def __init__(self, exc):
+            self.exc = exc
+
+        def check_tx(self, tx, sender=""):
+            raise self.exc
+
+    for exc, reason in (
+        (MempoolFullError("no evictable lower-priority txs"), "full"),
+        (SenderQuotaError("peerX", 3), "quota"),
+    ):
+        rpc = _rpc_server(mempool=RejectingMempool(exc))
+
+        async def run():
+            resp = await rpc._handle_jsonrpc(
+                _FakeRequest({"id": 7, "method": "broadcast_tx_sync",
+                              "params": {"tx": "00"}})
+            )
+            assert resp.status == 200  # JSON-RPC error, not an HTTP failure
+            body = _json.loads(resp.text)
+            assert body["error"]["code"] == -32001
+            assert body["error"]["data"]["reason"] == reason
+            assert "Traceback" not in body["error"]["data"]["detail"]
+
+        asyncio.run(run())
+
+
+def test_debug_overload_route_shape():
+    class Pool:
+        max_txs = 10
+        max_txs_bytes = 1000
+
+        def size(self):
+            return 3
+
+        def txs_bytes(self):
+            return 30
+
+        def is_full(self, n):
+            return False
+
+        evicted_total = 2
+        expired_total = 1
+
+    rpc = _rpc_server(mempool=Pool())
+
+    async def run():
+        out = await rpc._debug_overload({})
+        assert out["rpc"]["max_inflight_requests"] == 2
+        assert out["mempool"]["size"] == 3
+        assert out["mempool"]["evicted_total"] == 2
+        assert out["controller"] is None  # SimpleNamespace node: no controller
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# overload controller
+
+
+def _controller(mempool_fill):
+    from tendermint_tpu.config.config import OverloadConfig
+    from tendermint_tpu.node.overload import OverloadController
+    from tendermint_tpu.rpc.server import LoadGate
+
+    class Pool:
+        max_txs = 100
+        max_txs_bytes = 10 ** 9
+
+        def __init__(self):
+            self.n = 0
+
+        def size(self):
+            return self.n
+
+        def txs_bytes(self):
+            return 0
+
+    pool = Pool()
+    pool.n = mempool_fill
+    gate = LoadGate(10)
+    reg = M.Registry()
+    node = SimpleNamespace(
+        mempool=pool,
+        consensus=SimpleNamespace(_queue=asyncio.Queue(maxsize=100)),
+        rpc_server=SimpleNamespace(gate=gate),
+        switch=None,
+        mempool_reactor=SimpleNamespace(shed=False),
+        overload=None,
+    )
+    ctl = OverloadController(node, OverloadConfig(), metrics=M.OverloadMetrics(reg))
+    return ctl, node, pool, gate
+
+
+def test_controller_level_transitions_with_hysteresis():
+    ctl, node, pool, gate = _controller(0)
+    assert ctl.evaluate() == 0
+    assert not node.mempool_reactor.shed and not gate.shed_writes
+
+    pool.n = 75  # >= elevated watermark 0.7
+    assert ctl.evaluate() == 1
+    assert node.mempool_reactor.shed
+    assert gate.shed_writes and not gate.shed_reads
+
+    pool.n = 95  # >= critical watermark 0.9
+    assert ctl.evaluate() == 2
+    assert gate.shed_reads
+
+    pool.n = 80  # 0.8: above 0.8*critical(0.72) -> stays critical
+    assert ctl.evaluate() == 2
+
+    pool.n = 60  # 0.6: below 0.72 but above 0.8*elevated(0.56) -> elevated
+    assert ctl.evaluate() == 1
+    assert not gate.shed_reads and gate.shed_writes
+
+    pool.n = 10  # recovery: everything re-admitted
+    assert ctl.evaluate() == 0
+    assert not node.mempool_reactor.shed
+    assert not gate.shed_writes and not gate.shed_reads
+    assert ctl.transitions_up == 2 and ctl.transitions_down == 2
+
+    snap = ctl.snapshot()
+    assert snap["level"] == 0 and snap["level_name"] == "normal"
+    assert snap["shed"]["votes"] is False
+    assert "mempool" in snap["signals"]
+
+
+def test_controller_boundary_no_flap():
+    ctl, node, pool, gate = _controller(0)
+    pool.n = 70
+    levels = set()
+    for _ in range(10):
+        levels.add(ctl.evaluate())
+    assert levels == {1}  # sits at elevated, no oscillation
+    assert ctl.transitions_up == 1
+
+
+def test_controller_samples_rpc_and_queue_signals():
+    ctl, node, pool, gate = _controller(0)
+    for _ in range(9):
+        gate.enter()
+    node.consensus._queue.put_nowait(object())
+    sig = ctl.sample()
+    assert sig["rpc_inflight"] == 0.9
+    assert sig["consensus_queue"] == 0.01
+    assert sig["mempool"] == 0.0
+
+
+def test_mempool_reactor_sheds_gossip_when_full_or_switched():
+    from tendermint_tpu.mempool.reactor import MempoolReactor, encode_txs
+
+    class Pool:
+        def __init__(self):
+            self.full = False
+            self.checked = []
+
+        def is_full(self, n):
+            return self.full
+
+        def check_tx(self, tx, sender=""):
+            self.checked.append(tx)
+
+        def entries(self):
+            return []
+
+    pool = Pool()
+    reg = M.Registry()
+    r = MempoolReactor(pool, metrics=M.OverloadMetrics(reg))
+    peer = SimpleNamespace(id="peerZ")
+
+    async def run():
+        await r.receive(0x30, peer, encode_txs([b"t1"]))
+        assert pool.checked == [b"t1"]
+        pool.full = True
+        await r.receive(0x30, peer, encode_txs([b"t2", b"t3"]))
+        assert pool.checked == [b"t1"]  # no CheckTx (or decode) paid for shed batches
+        assert r.shed_rx == 1  # counts dropped MESSAGES, decode is skipped
+        pool.full = False
+        r.shed = True  # overload controller switch
+        await r.receive(0x30, peer, encode_txs([b"t4"]))
+        assert r.shed_rx == 2
+        r.shed = False
+        await r.receive(0x30, peer, encode_txs([b"t5"]))
+        assert pool.checked == [b"t1", b"t5"]
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# ABCI resilience
+
+
+def _start_app_server(port=0):
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.abci.socket import SocketServer
+
+    last = None
+    for _ in range(40):  # rebinding a just-closed port can race the kernel
+        try:
+            srv = SocketServer(f"tcp://127.0.0.1:{port}", KVStoreApplication())
+            srv.start()
+            return srv, srv.bound_addr[1]
+        except OSError as e:
+            last = e
+            time.sleep(0.05)
+    raise last
+
+
+def test_reconnecting_client_survives_app_restart():
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.client import ReconnectingClient
+    from tendermint_tpu.abci.socket import socket_client_creator
+
+    srv, port = _start_app_server()
+    addr = f"tcp://127.0.0.1:{port}"
+    rc = ReconnectingClient(
+        socket_client_creator(addr, call_timeout=5.0),
+        attempts=20, base_delay=0.05, max_delay=0.2, name="mempool",
+    )
+    try:
+        assert rc.check_tx(abci.RequestCheckTx(tx=b"k=v")).code == 0
+        # kill the app (listener AND live conns) — then restart on the port
+        srv.stop()
+        time.sleep(0.05)
+        srv, _ = _start_app_server(port)
+        # the wrapped conn reconnects with backoff and the call succeeds
+        assert rc.check_tx(abci.RequestCheckTx(tx=b"k2=v2")).code == 0
+        assert rc.reconnects >= 1
+    finally:
+        rc.close()
+        srv.stop()
+
+
+def test_raw_consensus_conn_stays_fatal_loud():
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.socket import SocketClient
+
+    srv, port = _start_app_server()
+    client = SocketClient(f"tcp://127.0.0.1:{port}", call_timeout=5.0)
+    try:
+        assert client.info(abci.RequestInfo()) is not None
+        srv.stop()
+        time.sleep(0.1)
+        with pytest.raises((ConnectionError, OSError)):
+            client.info(abci.RequestInfo())
+        # and it STAYS dead: no silent recovery on a later call
+        with pytest.raises((ConnectionError, OSError)):
+            client.info(abci.RequestInfo())
+        assert client.is_dead()
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_abci_chaos_fail_point_kills_app_mid_flight():
+    """The `abci_client_call` fail point lets a chaos schedule kill the app
+    server just before a call is written — the ReconnectingClient must ride
+    through it (restarted app), the raw client must not."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.client import ReconnectingClient
+    from tendermint_tpu.abci.socket import socket_client_creator
+    from tendermint_tpu.libs import fail
+
+    srv, port = _start_app_server()
+    addr = f"tcp://127.0.0.1:{port}"
+    state = {"srv": srv, "armed": True}
+
+    def kill_app_once():
+        if state["armed"]:
+            state["armed"] = False
+            state["srv"].stop()
+            state["srv"], _ = _start_app_server(port)
+
+    rc = ReconnectingClient(
+        socket_client_creator(addr, call_timeout=5.0),
+        attempts=20, base_delay=0.05, max_delay=0.2, name="query",
+    )
+    try:
+        assert rc.info(abci.RequestInfo()) is not None  # conn established
+        fail.inject("abci_client_call", kill_app_once)
+        res = rc.info(abci.RequestInfo())
+        assert res is not None
+        assert rc.reconnects >= 1
+    finally:
+        fail.inject("abci_client_call", None)
+        rc.close()
+        state["srv"].stop()
+
+
+def test_appconns_wraps_only_non_consensus_conns():
+    from tendermint_tpu.abci.client import LocalClient, ReconnectingClient
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.proxy.multi import AppConns, local_client_creator
+
+    conns = AppConns(local_client_creator(KVStoreApplication()), resilient=True)
+    assert isinstance(conns.consensus, LocalClient)  # never wrapped
+    for c in (conns.mempool, conns.query, conns.snapshot):
+        assert isinstance(c, ReconnectingClient)
+    conns.stop()
+
+    plain = AppConns(local_client_creator(KVStoreApplication()))
+    for c in (plain.consensus, plain.mempool, plain.query, plain.snapshot):
+        assert isinstance(c, LocalClient)
+    plain.stop()
+
+
+def test_node_with_socket_app_survives_mempool_conn_break(tmp_path):
+    """End-to-end: a single-validator node against an out-of-process socket
+    app keeps committing after the mempool connection is broken mid-chain
+    (ReconnectingClient path) — the node-level acceptance shape."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import gen_ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    srv, port = _start_app_server()
+    cfg = test_config()
+    cfg.base.db_backend = "memdb"
+    cfg.base.proxy_app = f"tcp://127.0.0.1:{port}"
+    cfg.base.abci = "socket"
+    cfg.base.abci_reconnect_base_delay = 0.05
+    cfg.base.abci_reconnect_attempts = 20
+    cfg.rpc.laddr = ""
+    cfg.root_dir = ""
+    cfg.consensus.wal_path = str(tmp_path / "wal")
+    priv = FilePV(gen_ed25519(b"\x91" * 32))
+    gen = GenesisDoc(chain_id="abci-restart",
+                     validators=[GenesisValidator(priv.get_pub_key(), 10)])
+    node = Node(cfg, gen, priv_validator=priv)
+
+    async def run():
+        await node.start()
+        try:
+            await node.wait_for_height(2, timeout=30)
+            # submit a tx through the (wrapped) mempool conn, then break it
+            node.mempool.check_tx(b"pre=break")
+            inner = node.proxy_app.mempool._client
+            assert inner is not None
+            inner.close()  # simulated broken pipe on the mempool conn
+            # next mempool call reconnects and succeeds; chain keeps going
+            res = node.mempool.check_tx(b"post=break")
+            assert res.code == abci.CODE_TYPE_OK
+            assert node.proxy_app.mempool.reconnects >= 1
+            h = node.block_store.height
+            await node.wait_for_height(h + 2, timeout=30)
+        finally:
+            await node.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        srv.stop()
