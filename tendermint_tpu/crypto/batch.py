@@ -504,11 +504,11 @@ class _RlcCall:
 
     __slots__ = (
         "precheck", "n", "na", "mode", "dev", "a_rows", "prep_seconds",
-        "ed_pos", "sr_pos", "ne", "ns",
+        "ed_pos", "sr_pos", "ne", "ns", "fused",
     )
 
     def __init__(self, precheck, n, na, mode, dev, a_rows, prep_seconds,
-                 ed_pos=None, sr_pos=None, ne=0, ns=0):
+                 ed_pos=None, sr_pos=None, ne=0, ns=0, fused=False):
         self.precheck = precheck
         self.n = n
         self.na = na
@@ -520,6 +520,7 @@ class _RlcCall:
         self.sr_pos = sr_pos  # mixed: row index per sr R lane
         self.ne = ne  # mixed: ed R lane-bucket size
         self.ns = ns  # mixed: sr R lane-bucket size
+        self.fused = fused  # submitted through the fused MSM pipeline
 
 
 # Timing of the last completed RLC call (host-prep vs total), for bench.py.
@@ -531,6 +532,18 @@ LAST_RLC_TIMINGS: dict = {}
 # (same model as LAST_RLC_TIMINGS): concurrent flushes may interleave fields,
 # which is acceptable for observability and free on the hot path.
 LAST_FLUSH_DETAIL: dict = {}
+
+
+def _record_submit_counters(msm_jax_mod, before: dict) -> None:
+    """Flush-detail deltas of the submit-path device-traffic counters
+    (thread-local in msm_jax, so concurrent submits from the prewarm
+    thread and the event loop never contaminate each other's deltas)."""
+    counters = msm_jax_mod.flush_counters()
+    LAST_FLUSH_DETAIL["h2d_bytes"] = counters["h2d_bytes"] - before["h2d_bytes"]
+    LAST_FLUSH_DETAIL["device_dispatches"] = (
+        counters["dispatches"] - before["dispatches"]
+    )
+    LAST_FLUSH_DETAIL["fused"] = msm_jax_mod.last_submit_fused()
 
 
 def _sample_z(rng, n: int, precheck) -> list:
@@ -575,6 +588,10 @@ def _rlc_submit(
 
     _device_fault("rlc_submit")
     t0 = time.perf_counter()
+    # Per-flush device-traffic accounting (tests/test_flush_budget.py pins
+    # budgets on the deltas): dispatches + H2D bytes this submit produces.
+    msm_jax._set_submit_fused(False)
+    counters0 = dict(msm_jax.flush_counters())
     n = len(pubkeys)
     mixed = key_types is not None and any(t == "sr25519" for t in key_types)
     from tendermint_tpu import native
@@ -673,6 +690,9 @@ def _rlc_submit(
         if len(rows):
             block[:, :, rows] = store_slice
         dev = tuple(_jax.device_put(block[c]) for c in range(4))
+        # an A-block upload is real H2D traffic this flush paid (cache
+        # hits above return without it — that's the budget being guarded)
+        msm_jax.flush_counters()["h2d_bytes"] += block.nbytes
         with _A_LOCK:
             while len(_DEV_A_CACHE) >= _DEV_A_MAX:
                 _DEV_A_CACHE.pop(next(iter(_DEV_A_CACHE)))
@@ -702,11 +722,12 @@ def _rlc_submit(
         for j, i in enumerate(sr_pos):
             scalars[na + ne + j] = zs[i]
         dev = msm_jax.rlc_check_cached_mixed_submit(_a_block(), ed_r, sr_r, scalars)
+        _record_submit_counters(msm_jax, counters0)
         return _RlcCall(
             precheck, n, na, "mixed", dev, None, time.perf_counter() - t0,
             ed_pos=np.asarray(ed_pos, dtype=np.int64),
             sr_pos=np.asarray(sr_pos, dtype=np.int64),
-            ne=ne, ns=ns,
+            ne=ne, ns=ns, fused=msm_jax.last_submit_fused(),
         )
 
     # A block: [A_0..A_{n-1}, B, pads]; excluded/pad lanes are the basepoint
@@ -741,9 +762,11 @@ def _rlc_submit(
         dev = msm_jax.rlc_check_submit(
             np.concatenate([pts_a, pts_r], axis=0), scalars, zero16_from=na
         )
+    _record_submit_counters(msm_jax, counters0)
     return _RlcCall(
         precheck, n, na, "cached" if cached else "plain", dev,
         a_rows if not cached else None, time.perf_counter() - t0,
+        fused=msm_jax.last_submit_fused(),
     )
 
 
@@ -820,27 +843,45 @@ def _verify_batch_rlc(
     """RLC fast path. Returns the bool mask if the combined check passes,
     or None when the caller must fall back to the per-signature kernel
     (some signature failed, or an encoding was invalid)."""
+    from tendermint_tpu.ops import msm_jax
+
     tr = _trace.tracer if _trace.tracer.enabled else None
     t0 = time.perf_counter()
-    try:
-        if tr is not None:
-            with tr.span("rlc.submit", n=len(pubkeys)):
+    for attempt in range(2):
+        call = None
+        try:
+            if tr is not None:
+                with tr.span("rlc.submit", n=len(pubkeys)):
+                    call = _rlc_submit(pubkeys, msgs, sigs, key_types)
+                with tr.span("rlc.finish", mode=call.mode):
+                    mask = _rlc_finish(call)
+            else:
                 call = _rlc_submit(pubkeys, msgs, sigs, key_types)
-            with tr.span("rlc.finish", mode=call.mode):
                 mask = _rlc_finish(call)
-        else:
-            call = _rlc_submit(pubkeys, msgs, sigs, key_types)
-            mask = _rlc_finish(call)
-    except Exception:
-        # Any unexpected RLC-path failure (cache churn past capacity, device
-        # error) degrades to the always-correct per-signature fallback
-        # rather than propagating into the consensus receive loop.
-        import logging
+            break
+        except Exception as e:
+            import logging
 
-        logging.getLogger("tendermint_tpu.crypto.batch").exception(
-            "RLC fast path failed; falling back to per-signature verification"
-        )
-        return None
+            # Per-call fused flag when the submit completed; the module
+            # global only for a failure inside the submit itself (a
+            # concurrent thread's submit could have rewritten it since).
+            fused_attempt = (
+                call.fused if call is not None else msm_jax.last_submit_fused()
+            )
+            if attempt == 0 and fused_attempt:
+                # A fused-pipeline failure (e.g. a Mosaic lowering rejection
+                # on this TPU generation) must not cost the RLC path: stick
+                # to the unfused reference schedule and retry this flush.
+                msm_jax.disable_fused(repr(e))
+                continue
+            # Any other unexpected RLC-path failure (cache churn past
+            # capacity, device error) degrades to the always-correct
+            # per-signature fallback rather than propagating into the
+            # consensus receive loop.
+            logging.getLogger("tendermint_tpu.crypto.batch").exception(
+                "RLC fast path failed; falling back to per-signature verification"
+            )
+            return None
     LAST_RLC_TIMINGS.update(
         prep_ms=call.prep_seconds * 1e3,
         total_ms=(time.perf_counter() - t0) * 1e3,
@@ -932,6 +973,18 @@ def _verify_batch_rlc_sharded(
     na = _lane_bucket(n + 1)
     while (2 * na) % nd:
         na += 1
+    # Round the per-shard lane count up to a fused-chunk multiple when the
+    # padding stays modest (<= 25%): each shard then runs the VMEM-resident
+    # fused stage pipeline (ops/pallas_msm.py) instead of the per-level
+    # schedule — e.g. 10k validators on 8 chips pad 20480 -> 24576 lanes
+    # (3x1024 per shard) for the fused tree/prefix/bucket kernels.
+    from tendermint_tpu.ops import msm_jax as _msm
+
+    if _msm.fused_for_lanes(nd * 1024):
+        target = nd * 1024
+        padded = -(-2 * na // target) * target
+        if 4 * padded <= 5 * (2 * na):
+            na = padded // 2
     b_enc = np.frombuffer(point_compress(BASE), dtype=np.uint8)
     pts = np.tile(b_enc, (2 * na, 1))
     if precheck.any():
@@ -1146,6 +1199,12 @@ def verify_batch_finish(h: BatchHandle) -> np.ndarray:
         # to CPU once the threshold is hit (instead of re-dispatching every
         # queued handle into a dead device)
         BREAKER.record_failure(repr(e))
+        if h._call is not None and h._call.fused:
+            # a fused-pipeline execution failure: later submits must build
+            # the unfused reference graph (this flush recovers below)
+            from tendermint_tpu.ops import msm_jax
+
+            msm_jax.disable_fused(repr(e))
         import logging
 
         logging.getLogger("tendermint_tpu.crypto.batch").exception(
@@ -1168,6 +1227,9 @@ def verify_batch_finish(h: BatchHandle) -> np.ndarray:
             padding_lanes=detail.get("padding_lanes"),
             cache_hits=detail.get("cache_hits"),
             cache_misses=detail.get("cache_misses"),
+            fused=detail.get("fused"),
+            h2d_bytes=detail.get("h2d_bytes"),
+            device_dispatches=detail.get("device_dispatches"),
             tracer_=tr,
         )
         return mask
@@ -1286,6 +1348,9 @@ def verify_batch(
         cache_hits=detail.get("cache_hits"),
         cache_misses=detail.get("cache_misses"),
         rlc_fallback=detail.get("rlc_fallback", False),
+        fused=detail.get("fused"),
+        h2d_bytes=detail.get("h2d_bytes"),
+        device_dispatches=detail.get("device_dispatches"),
         tracer_=tr,
     )
     if span is not None:

@@ -202,6 +202,9 @@ def record_flush(
     cache_hits: Optional[int] = None,
     cache_misses: Optional[int] = None,
     rlc_fallback: bool = False,
+    fused: Optional[bool] = None,
+    h2d_bytes: Optional[int] = None,
+    device_dispatches: Optional[int] = None,
     tracer_: Optional[Tracer] = None,
 ) -> None:
     """One batch-verify flush completed. Called by crypto/batch.verify_batch
@@ -257,6 +260,12 @@ def record_flush(
             last["pubkey_cache_hit_rate"] = round(hits / (hits + misses), 4)
     if rlc_fallback:
         last["rlc_fallback"] = True
+    if fused is not None:
+        last["fused"] = bool(fused)
+    if h2d_bytes is not None:
+        last["h2d_bytes"] = h2d_bytes
+    if device_dispatches is not None:
+        last["device_dispatches"] = device_dispatches
     with _STATS_LOCK:
         t = _TOTALS.setdefault(
             (backend, path), {"flushes": 0, "sigs": 0, "seconds": 0.0}

@@ -43,6 +43,7 @@ def _src_hash() -> str:
             "ed25519_jax.py",
             "msm_jax.py",
             "pallas_fe.py",
+            "pallas_msm.py",  # fused-pipeline kernels (traced into *_f keys)
             "ristretto_jax.py",  # traced into the mixed kernel
         ):
             with open(os.path.join(base, mod), "rb") as f:
@@ -50,6 +51,22 @@ def _src_hash() -> str:
         h.update(jax.__version__.encode())
         _SRC_HASH = h.hexdigest()[:16]
     return _SRC_HASH
+
+
+def _machine_key() -> str:
+    """Host machine fingerprint component of artifact keys. An artifact's
+    first CALL compiles through XLA's persistent cache, whose CPU entries
+    bake in host CPU features — loading a foreign-machine artifact then
+    fails in cpu_aot_loader (the failure that killed every MULTICHIP round,
+    MULTICHIP_r05.json). Keying on the fingerprint makes a foreign artifact
+    a MISS — skipped and re-exported — never loaded. TPU programs are
+    host-portable, so only the backend that compiles for the host CPU is
+    scoped."""
+    if jax.default_backend() != "cpu":
+        return "anyhost"
+    from tendermint_tpu.ops.cache_hardening import machine_fingerprint
+
+    return machine_fingerprint()
 
 
 def _cache_dir() -> str | None:
@@ -110,7 +127,10 @@ def call(name: str, jit_fn, *args):
     machinery failure."""
     if not enabled():
         return jit_fn(*args)
-    key = f"{name}-{jax.default_backend()}-{_src_hash()}-{_arg_key(args)}"
+    key = (
+        f"{name}-{jax.default_backend()}-{_machine_key()}-"
+        f"{_src_hash()}-{_arg_key(args)}"
+    )
     fn = _MEM.get(key)
     if fn is not None:
         return fn(*args)

@@ -20,10 +20,60 @@ before the first compile in any process that shares a cache directory
 
 from __future__ import annotations
 
+import hashlib
 import os
 import tempfile
 
 _PATCHED = False
+_FINGERPRINT: str | None = None
+
+
+def machine_fingerprint() -> str:
+    """Short stable hash of the execution host: CPU architecture + feature
+    flags + jax/jaxlib versions.
+
+    Why: XLA:CPU executables bake in the COMPILE machine's feature set
+    (avx512*, amx-*, ...). jax's persistent compile cache keys entries by
+    program + compile options only, so an artifact compiled on one machine
+    is happily LOADED on another — where cpu_aot_loader rejects it
+    ("Target machine feature ... is not supported on the host machine") or,
+    worse, the code SIGILLs. This killed every MULTICHIP round to date
+    (MULTICHIP_r05.json). Scoping the cache by this fingerprint makes a
+    foreign artifact a cache MISS (skipped, recompiled) instead of a load
+    failure."""
+    global _FINGERPRINT
+    if _FINGERPRINT is not None:
+        return _FINGERPRINT
+    import platform
+
+    h = hashlib.sha256()
+    h.update(platform.machine().encode())
+    try:  # CPU feature set: the first `flags`/`Features` line of cpuinfo
+        with open("/proc/cpuinfo", "rb") as f:
+            for line in f:
+                if line.startswith((b"flags", b"Features")):
+                    h.update(b" ".join(sorted(line.split(b":")[-1].split())))
+                    break
+    except OSError:  # non-Linux: arch + versions still scope the cache
+        pass
+    for dist in ("jax", "jaxlib"):
+        try:
+            from importlib import metadata
+
+            h.update(f"{dist}={metadata.version(dist)}".encode())
+        except Exception:
+            pass
+    _FINGERPRINT = h.hexdigest()[:12]
+    return _FINGERPRINT
+
+
+def machine_scoped_cache_dir(base: str) -> str:
+    """Scope an XLA:CPU persistent-cache directory per machine fingerprint,
+    so hosts with different CPU feature sets never load each other's
+    executables (see machine_fingerprint). TPU cache dirs should NOT be
+    scoped: TPU programs are keyed by device kind and cross-host reuse is
+    the warm-start win."""
+    return os.path.join(base, f"mach-{machine_fingerprint()}")
 
 
 def _sweep_stale_tmps(path) -> None:

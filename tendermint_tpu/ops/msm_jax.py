@@ -65,6 +65,7 @@ variant `_rlc_core_cached` accepts predecompressed A coordinates.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Sequence, Tuple
 
 import os
@@ -129,6 +130,90 @@ def _use_pallas() -> bool:
     from tendermint_tpu.ops import pallas_fe
 
     return pallas_fe.enabled()
+
+
+# ---------------------------------------------------------------------------
+# Fused-pipeline selection (ops/pallas_msm.py). The fused schedule keeps the
+# gather/up-sweep/prefix/bucket stages VMEM-resident in one packed layout;
+# the unfused per-level schedule below stays as the differential reference
+# and the fallback for lane counts no chunk size tiles.
+
+# Sticky runtime kill switch: the first hardware failure of the fused path
+# (e.g. a Mosaic lowering rejection on some TPU generation) flips this and
+# every later submit builds the unfused graph — crypto/batch.py retries the
+# failed flush unfused, so one bad compile costs one retry, not the RLC path.
+_FUSED_DISABLED: list = [None]  # reason string once disabled
+
+# Submit-path accounting is PER THREAD: the prewarm thread and the
+# consensus event loop may submit concurrently, and thread-local state
+# keeps one flush's byte/dispatch deltas and fused flag from being
+# attributed to another's — without serializing the submit path (host
+# prep plus a first-call kernel compile can take minutes) behind a lock.
+class _FlushThreadState(__import__("threading").local):
+    def __init__(self):
+        self.counters = {"h2d_bytes": 0, "dispatches": 0}
+        self.last_fused = False
+
+
+_FLUSH_TLS = _FlushThreadState()
+
+
+def flush_counters() -> dict:
+    """This thread's cumulative submit-path device-traffic counters
+    ("h2d_bytes", "dispatches"). Tests pin a per-flush budget on the deltas
+    (tests/test_flush_budget.py) so a regression that reintroduces per-flush
+    uploads or extra dispatches fails tier-1 instead of only showing up in a
+    lost bench round."""
+    return _FLUSH_TLS.counters
+
+
+def last_submit_fused() -> bool:
+    """Whether this thread's most recent rlc_check_*_submit built the fused
+    graph (observability: crypto/batch.py copies it into the flush detail)."""
+    return _FLUSH_TLS.last_fused
+
+
+def _set_submit_fused(fused: bool) -> None:
+    _FLUSH_TLS.last_fused = bool(fused)
+
+
+def _dispatch(name: str, jit_fn, *args):
+    """aot_cache.call with device-traffic accounting: every numpy leaf is a
+    host->device upload on this call; jax-array leaves are device-resident."""
+    c = _FLUSH_TLS.counters
+    c["dispatches"] += 1
+    for leaf in jax.tree_util.tree_leaves(args):
+        if isinstance(leaf, np.ndarray):
+            c["h2d_bytes"] += leaf.nbytes
+    return aot_cache.call(name, jit_fn, *args)
+
+
+def fused_for_lanes(n_lanes: int) -> bool:
+    """Route this lane count through the fused pipeline? TMTPU_FUSED_MSM:
+    "0" never, "1" always (CPU twins included — tests), "auto" (default)
+    with the Pallas kernels only."""
+    if _FUSED_DISABLED[0] is not None:
+        return False
+    mode = os.environ.get("TMTPU_FUSED_MSM", "auto")
+    if mode == "0":
+        return False
+    from tendermint_tpu.ops import pallas_msm
+
+    if pallas_msm.chunk_for_lanes(n_lanes) is None:
+        return False
+    return True if mode == "1" else _use_pallas()
+
+
+def disable_fused(reason: str) -> None:
+    """Sticky per-process disable after a fused-path failure (see
+    crypto/batch.py's retry); re-enabled only by a fresh process."""
+    if _FUSED_DISABLED[0] is None:
+        _FUSED_DISABLED[0] = reason
+        import logging
+
+        logging.getLogger("tendermint_tpu.ops.msm").warning(
+            "fused MSM pipeline disabled for this process: %s", reason
+        )
 
 
 def _padd(C: SmallCtx, p: Point, q: Point) -> Point:
@@ -603,19 +688,148 @@ def _msm_is_identity(C: SmallCtx, pts: Point, perm, node_idx) -> jnp.ndarray:
     return point_is_identity(C, _msm_total(C, pts, perm, node_idx))
 
 
+# ---------------------------------------------------------------------------
+# Fused pipeline (ops/pallas_msm.py): the same MSM with the tree/prefix/
+# bucket stages as VMEM-resident fused kernels in ONE packed limb layout.
+#
+# Storage map (row indices into the concatenated gatherable row table):
+#   [0, T*N)                       level-0 lanes, bit-reversed within chunks
+#   [G1, G1 + T*ncw*rows_out*128)  chunk trees (levels 1..lc, chunk-major)
+#   [G2, G2 + T*(Wtop+1))          top tree over chunk roots + identity lane
+# A bucket boundary e decomposes as: full chunks [0, e>>lc) via the top
+# tree's Fenwick nodes (the old aligned-block derivation over ncw chunk
+# totals), plus the bits of e & (ch-1) via level-0/chunk-tree nodes of the
+# partial chunk — at bit-reversed in-level positions (pallas_msm docstring).
+
+
+def fused_node_indices_device(ends: jnp.ndarray, n_lanes: int, ch: int) -> jnp.ndarray:
+    """ends (T, NBUCKETS) int32 -> (NBUCKETS, T, Kf) int32 global row
+    indices, bucket-major (v-major) so the downstream reduce/bucket kernels
+    see flat lane order v*T + t."""
+    from tendermint_tpu.ops import pallas_msm as PM
+
+    g = PM.chunk_geometry(ch)
+    ncw = n_lanes // ch
+    t_ = ends.shape[0]
+    toffs, ttot = level_offsets(ncw)
+    wtop1 = ttot + 1
+    g1 = t_ * n_lanes
+    g2 = g1 + t_ * ncw * g.rows_out * 128
+
+    e = jnp.asarray(ends).astype(jnp.int32).T[..., None]  # (NB, T, 1)
+    w = jnp.arange(t_, dtype=jnp.int32)[None, :, None]
+    ce = e >> g.lc
+    r = e & (ch - 1)
+    idn = g2 + w * wtop1 + ttot  # per-window identity lane
+
+    # partial-chunk part: levels 0..lc-1, present iff bit l of r
+    lvl = jnp.arange(g.lc, dtype=jnp.int32)
+    bit = (r >> lvl) & 1
+    j = (r >> (lvl + 1)) << 1
+    q = PM.brev_jnp(j, g.lc - lvl)  # in-level bit-reversed position
+    roff = jnp.asarray(g.row_off, dtype=jnp.int32)
+    idx0 = w * n_lanes + ce * ch + q
+    idxl = (
+        g1
+        + (w * ncw + ce) * (g.rows_out * 128)
+        + (roff[lvl] + (q >> 7)) * 128
+        + (q & 127)
+    )
+    cidx = jnp.where(lvl == 0, idx0, idxl)
+    cidx = jnp.where(bit == 1, cidx, idn)
+
+    # full-chunks part: the old Fenwick derivation over ncw chunk totals
+    lt = len(toffs)
+    lvl2 = jnp.arange(lt, dtype=jnp.int32)
+    bit2 = (ce >> lvl2) & 1
+    jt = (ce >> (lvl2 + 1)) << 1
+    tidx = g2 + w * wtop1 + jnp.asarray(toffs, dtype=jnp.int32)[lvl2] + jt
+    tidx = jnp.where(bit2 == 1, tidx, idn)
+    return jnp.concatenate([cidx, tidx], axis=-1)
+
+
+def _msm_total_fused(C: SmallCtx, pts: Point, perm, ends) -> Point:
+    """The fused-schedule twin of _msm_total: identical group element,
+    different (VMEM-resident) evaluation order. pts (20, N); perm (T, N)
+    natural sorted order (the bit-reversal is composed in here); ends
+    (T, NBUCKETS)."""
+    from tendermint_tpu.ops import pallas_msm as PM
+
+    perm = jnp.asarray(perm).astype(jnp.int32)
+    t_ = perm.shape[0]
+    n = pts.x.shape[-1]
+    ch = PM.chunk_for_lanes(n)
+    g = PM.chunk_geometry(ch)
+    ncw = n // ch
+
+    # gather lanes directly into fused order: whole 320-byte point rows
+    # (the r5 row-gather layout), chunk-wise bit-reversed via the composed
+    # permutation — the only big gather the tree phase pays.
+    perm_f = jnp.take(perm, jnp.asarray(PM.brev_positions(n, ch)), axis=1)
+    rowtab = jnp.stack([c.T for c in pts], axis=1).reshape(n, 4 * fe.NLIMBS)
+    g_rows = rowtab[perm_f.reshape(-1)]  # (T*N, 80)
+
+    # chunk trees: ONE kernel computes levels 1..lc per chunk in VMEM
+    ctree = PM.uptree(PM.rows_to_packed(g_rows), ch)
+    ctree_rows = PM.packed_to_rows(ctree)
+
+    # top tree over the T*ncw chunk roots (tiny; existing limb-major path)
+    root_row = g.row_off[g.lc]
+    roots = ctree.reshape(4, fe.NLIMBS, t_ * ncw, g.rows_out, 128)[
+        :, :, :, root_row, 0
+    ]
+    roots_pt = Point(*(roots[c].reshape(fe.NLIMBS, t_, ncw) for c in range(4)))
+    top = _tree_levels(C, roots_pt)  # (20, T, Wtop+1) incl. identity lane
+    wtop1 = top.x.shape[-1]
+    top_rows = jnp.stack(
+        [jnp.moveaxis(c, 0, -1) for c in top], axis=-2
+    ).reshape(t_ * wtop1, 4 * fe.NLIMBS)
+
+    # Fenwick prefix extraction: row-gather the decomposition nodes, reduce
+    # them in ONE accumulating kernel (no materialized (T,256,K) tensor)
+    all_rows = jnp.concatenate([g_rows, ctree_rows, top_rows], axis=0)
+    node_idx = fused_node_indices_device(ends, n, ch)  # (NB, T, Kf)
+    kf = node_idx.shape[-1]
+    gathered = all_rows[node_idx.reshape(-1)]  # (NB*T*Kf, 80)
+    gk = jnp.moveaxis(gathered.reshape(NBUCKETS * t_, kf, 4 * fe.NLIMBS), 1, 0)
+    gk = jnp.moveaxis(gk, -1, 1).reshape(
+        kf, 4, fe.NLIMBS, NBUCKETS * t_ // 128, 128
+    )
+    prefix = PM.fenwick_reduce(gk)  # packed, v-major
+
+    # weighted bucket sum: one fused fold kernel + the tiny (20, T) tail
+    s_coords, p255_coords = PM.bucket_fold(prefix, t_)
+    s_pt = Point(*s_coords)
+    p_last = Point(*p255_coords)
+    m = _pdbl_n(C, p_last, WINDOW_BITS)  # [256] P_255
+    m = _padd(C, m, _pneg(C, p_last))  # [255] P_255
+    w_pts = _padd(C, m, _pneg(C, s_pt))  # (20, T) per-window sums
+    return _combine_windows(C, w_pts)
+
+
+def _msm_check(C: SmallCtx, pts: Point, perm, ends, fused: bool) -> jnp.ndarray:
+    """Batch-identity check routing: fused (VMEM-resident schedule) vs the
+    unfused per-level reference. `fused` is trace-static — the two variants
+    are distinct jit programs (and distinct AOT artifacts)."""
+    if fused:
+        return point_is_identity(C, _msm_total_fused(C, pts, perm, ends))
+    node_idx = fenwick_nodes_device(ends, pts.x.shape[-1])
+    return _msm_is_identity(C, pts, perm, node_idx)
+
+
 def _rlc_core(
     pts_bytes: jnp.ndarray,  # (32, N) uint8 — A lanes, B lane, R lanes, pads
     perm: jnp.ndarray,  # (T, N) int/uint
     ends: jnp.ndarray,  # (T, NBUCKETS) int32 bucket boundaries
     fctx: FieldCtx,  # materialized at batch shape (N,) for decompress
     C: SmallCtx,
+    fused: bool = False,
 ) -> jnp.ndarray:
     """Returns bool (1+N,): [batch_ok, lane_ok...] packed into ONE array so
     the caller syncs in a single D2H round trip."""
-    node_idx = fenwick_nodes_device(ends, pts_bytes.shape[1])
     p, ok = decompress(fctx, pts_bytes)
     p = _pselect(ok, p, identity(fctx))
-    bok = _msm_is_identity(C, p, perm, node_idx)
+    bok = _msm_check(C, p, perm, ends, fused)
     return jnp.concatenate([bok[None], ok])
 
 
@@ -626,10 +840,10 @@ def _rlc_core_cached(
     ends,  # (T, NBUCKETS) int32
     fctx: FieldCtx,  # at shape (Nr,)
     C: SmallCtx,
+    fused: bool = False,
 ) -> jnp.ndarray:
     """Cached-A variant: lanes = [A block | R block]; only R is decompressed.
     Returns bool (1+Nr,): [batch_ok, r_ok...]."""
-    node_idx = fenwick_nodes_device(ends, ax.shape[1] + r_bytes.shape[1])
     r, r_ok = decompress(fctx, r_bytes)
     r = _pselect(r_ok, r, identity(fctx))
     pts = Point(
@@ -638,7 +852,7 @@ def _rlc_core_cached(
             for a, b in zip(Point(ax, ay, az, at), r)
         )
     )
-    bok = _msm_is_identity(C, pts, perm, node_idx)
+    bok = _msm_check(C, pts, perm, ends, fused)
     return jnp.concatenate([bok[None], r_ok])
 
 
@@ -668,12 +882,13 @@ def _rlc_core_cached_dsort(
     digits,  # (Na+Nr, T) uint8 scalar digit rows (window w = byte w)
     fctx: FieldCtx,  # at shape (Nr,)
     C: SmallCtx,
+    fused: bool = False,
 ) -> jnp.ndarray:
     """_rlc_core_cached with the window sort in-graph (sort_windows_device):
     the host sends raw scalar digit rows; perm/ends/Fenwick nodes are all
     derived on device."""
     perm, ends = sort_windows_device(digits)
-    return _rlc_core_cached(ax, ay, az, at, r_bytes, perm, ends, fctx, C)
+    return _rlc_core_cached(ax, ay, az, at, r_bytes, perm, ends, fctx, C, fused)
 
 
 def _rlc_core_cached_mixed(
@@ -685,14 +900,11 @@ def _rlc_core_cached_mixed(
     fctx_ed: FieldCtx,  # at shape (Ne,)
     fctx_sr: FieldCtx,  # at shape (Ns,)
     C: SmallCtx,
+    fused: bool = False,
 ) -> jnp.ndarray:
     """Mixed-key-type cached-A variant: lanes = [A block | edR | srR].
     Returns bool (1+Ne+Ns,): [batch_ok, ed_r_ok..., sr_r_ok...]."""
     from tendermint_tpu.ops.ristretto_jax import ristretto_decode
-
-    node_idx = fenwick_nodes_device(
-        ends, ax.shape[1] + ed_r_bytes.shape[1] + sr_r_bytes.shape[1]
-    )
 
     er, er_ok = decompress(fctx_ed, ed_r_bytes)
     er = _pselect(er_ok, er, identity(fctx_ed))
@@ -704,14 +916,25 @@ def _rlc_core_cached_mixed(
             for a, b, c in zip(Point(ax, ay, az, at), er, sr)
         )
     )
-    bok = _msm_is_identity(C, pts, perm, node_idx)
+    bok = _msm_check(C, pts, perm, ends, fused)
     return jnp.concatenate([bok[None], er_ok, sr_ok])
 
 
+# The fused/unfused variants are separate jit objects (and carry distinct
+# AOT-cache names below): `fused` changes the traced graph, so it must never
+# share a compiled-program cache slot with the other variant.
 _rlc_jit = jax.jit(_rlc_core)
+_rlc_jit_fused = jax.jit(functools.partial(_rlc_core, fused=True))
 _rlc_cached_jit = jax.jit(_rlc_core_cached)
+_rlc_cached_jit_fused = jax.jit(functools.partial(_rlc_core_cached, fused=True))
 _rlc_cached_dsort_jit = jax.jit(_rlc_core_cached_dsort)
+_rlc_cached_dsort_jit_fused = jax.jit(
+    functools.partial(_rlc_core_cached_dsort, fused=True)
+)
 _rlc_cached_mixed_jit = jax.jit(_rlc_core_cached_mixed)
+_rlc_cached_mixed_jit_fused = jax.jit(
+    functools.partial(_rlc_core_cached_mixed, fused=True)
+)
 
 
 def _device_sort_enabled() -> bool:
@@ -775,8 +998,11 @@ def rlc_check_submit(
         digits = scalars_to_bytes(scalars, n)
         perm, ends = sort_windows(digits, zero16_from=zero16_from)
         fctx = make_ctx((n,))
-        return aot_cache.call(
-            "rlc_plain", _rlc_jit,
+        fused = fused_for_lanes(n)
+        _set_submit_fused(fused)
+        return _dispatch(
+            "rlc_plain_f" if fused else "rlc_plain",
+            _rlc_jit_fused if fused else _rlc_jit,
             np.ascontiguousarray(pts_bytes.T), perm, ends, fctx, make_small_ctx(),
         )
 
@@ -799,11 +1025,14 @@ def rlc_check_cached_submit(
     with _trace_span("kernel.rlc_submit", variant="cached", lanes=n):
         digits = scalars_to_bytes(scalars, n)
         fctx = make_ctx((nr,))
+        fused = fused_for_lanes(n)
+        _set_submit_fused(fused)
         if _device_sort_enabled():
             # digits go down raw; perm/ends are derived in-graph
             # (sort_windows_device) — no host sort, half the wire bytes.
-            return aot_cache.call(
-                "rlc_cached_ds", _rlc_cached_dsort_jit,
+            return _dispatch(
+                "rlc_cached_ds_f" if fused else "rlc_cached_ds",
+                _rlc_cached_dsort_jit_fused if fused else _rlc_cached_dsort_jit,
                 *a_coords,
                 np.ascontiguousarray(r_bytes.T),
                 digits,
@@ -813,8 +1042,9 @@ def rlc_check_cached_submit(
         # rows >= na are the z-lane (128-bit scalars) + padding: zero digits
         # in windows 16-31, so the sort skips their count pass
         perm, ends = sort_windows(digits, zero16_from=na)
-        return aot_cache.call(
-            "rlc_cached", _rlc_cached_jit,
+        return _dispatch(
+            "rlc_cached_f" if fused else "rlc_cached",
+            _rlc_cached_jit_fused if fused else _rlc_cached_jit,
             *a_coords,
             np.ascontiguousarray(r_bytes.T),
             perm,
@@ -849,8 +1079,11 @@ def rlc_check_cached_mixed_submit(
         digits = scalars_to_bytes(scalars, n)
         # rows >= na are the (128-bit) z-lane scalars of both R blocks
         perm, ends = sort_windows(digits, zero16_from=na)
-        return aot_cache.call(
-            "rlc_mixed", _rlc_cached_mixed_jit,
+        fused = fused_for_lanes(n)
+        _set_submit_fused(fused)
+        return _dispatch(
+            "rlc_mixed_f" if fused else "rlc_mixed",
+            _rlc_cached_mixed_jit_fused if fused else _rlc_cached_mixed_jit,
             *a_coords,
             np.ascontiguousarray(ed_r_bytes.T),
             np.ascontiguousarray(sr_r_bytes.T),

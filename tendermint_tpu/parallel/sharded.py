@@ -196,8 +196,10 @@ def sharded_rlc_check(mesh: Mesh):
     from tendermint_tpu.ops.ed25519_jax import decompress, identity
     from tendermint_tpu.ops.msm_jax import (
         _msm_total,
+        _msm_total_fused,
         _padd,
         _pselect,
+        fused_for_lanes,
         make_small_ctx,
         point_is_identity,
     )
@@ -210,7 +212,13 @@ def sharded_rlc_check(mesh: Mesh):
     _cache: dict = {}
 
     def _for_lanes(n: int):
-        fn = _cache.get(n)
+        # Each shard runs the FUSED VMEM-resident stage pipeline when its
+        # lane count tiles a chunk (ops/pallas_msm.py) — the same schedule
+        # the single-chip path runs, so multi-chip inherits every fused win.
+        # Keyed on the routing decision too: a runtime disable_fused() must
+        # not keep hitting a cached fused program.
+        fused = fused_for_lanes(n)
+        fn = _cache.get((n, fused))
         if fn is None:
             fctx = make_ctx((n,))
             spec_fctx = jax.tree.map(lambda _: P(), fctx)
@@ -227,10 +235,13 @@ def sharded_rlc_check(mesh: Mesh):
 
                 pts_bytes = pts_bytes[0]  # (32, n) local shard
                 perm = perm[0]
-                node_idx = fenwick_nodes_device(ends[0], n)
                 p, ok = decompress(fctx, pts_bytes)
                 p = _pselect(ok, p, identity(fctx))
-                part = _msm_total(C, p, perm, node_idx)  # partial sum (20,)
+                if fused:
+                    part = _msm_total_fused(C, p, perm, ends[0])
+                else:
+                    node_idx = fenwick_nodes_device(ends[0], n)
+                    part = _msm_total(C, p, perm, node_idx)  # partial (20,)
                 coords = jnp.stack(part)  # (4, 20)
                 allc = jax.lax.all_gather(coords, axis)  # (D, 4, 20)
                 from tendermint_tpu.ops.ed25519_jax import Point
@@ -243,7 +254,7 @@ def sharded_rlc_check(mesh: Mesh):
                 bok = point_is_identity(C, acc)
                 return bok, ok[None]
 
-            fn = _cache[n] = jax.jit(
+            fn = _cache[(n, fused)] = jax.jit(
                 lambda pb, pm, ni: _run(pb, pm, ni, make_ctx((n,)), make_small_ctx())
             )
         return fn

@@ -38,12 +38,17 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.abspath(
-        os.path.join(os.path.dirname(__file__), "..", ".jax_cache", _platform)
-    ),
+_cache_dir = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache", _platform)
 )
+if _platform == "cpu":
+    # XLA:CPU executables bake in the COMPILE host's CPU features; a cache
+    # shared across heterogeneous machines produced cpu_aot_loader
+    # machine-feature-mismatch failures (MULTICHIP_r05). Scope per machine.
+    from tendermint_tpu.ops.cache_hardening import machine_scoped_cache_dir
+
+    _cache_dir = machine_scoped_cache_dir(_cache_dir)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 # Atomic cache-entry writes: an OOM-killed test run must never leave a

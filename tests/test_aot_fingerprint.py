@@ -1,0 +1,85 @@
+"""Machine-fingerprint scoping of the AOT/persistent compile caches.
+
+XLA:CPU executables bake in the COMPILE host's CPU feature set; sharing a
+cache across heterogeneous machines made cpu_aot_loader reject (or SIGILL
+on) foreign entries — the failure that killed every MULTICHIP round
+(MULTICHIP_r05.json). The fix is scoping: a foreign-machine artifact must
+be a cache MISS (skipped, recompiled), never a load.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from tendermint_tpu.ops import aot_cache, cache_hardening
+
+
+def test_fingerprint_is_stable_and_short():
+    a = cache_hardening.machine_fingerprint()
+    b = cache_hardening.machine_fingerprint()
+    assert a == b
+    assert len(a) == 12
+    int(a, 16)  # hex
+
+
+def test_scoped_dir_composition():
+    fp = cache_hardening.machine_fingerprint()
+    assert cache_hardening.machine_scoped_cache_dir("/x/cpu") == f"/x/cpu/mach-{fp}"
+
+
+def test_aot_key_carries_machine_fingerprint_on_cpu():
+    assert jax.default_backend() == "cpu"
+    assert aot_cache._machine_key() == cache_hardening.machine_fingerprint()
+
+
+def test_foreign_machine_artifact_is_skipped_not_loaded(tmp_path, monkeypatch):
+    """An artifact exported under another machine's fingerprint must not be
+    deserialized on this one: the key misses and a fresh export is written
+    alongside it."""
+    # Redirect the EXPORT dir only — never rewire jax_compilation_cache_dir:
+    # jax's persistent compile cache latches its directory at the process's
+    # first compile (jax._src.compilation_cache._initialize_cache runs at
+    # most once), and this file sorts first in the suite — pointing the
+    # whole remaining session's XLA cache at a deleted tmp dir turns every
+    # later multi-minute kernel compile into a guaranteed miss.
+    export_dir = tmp_path / "export"
+    monkeypatch.setattr(aot_cache, "_cache_dir", lambda: str(export_dir))
+    try:
+        fn = jax.jit(lambda x: x * 2 + 1)
+        x = np.arange(16, dtype=np.int32)
+
+        monkeypatch.setattr(cache_hardening, "_FINGERPRINT", "aaaaaaaaaaaa")
+        out = aot_cache.call("fp_test", fn, x)
+        assert (np.asarray(out) == x * 2 + 1).all()
+        first = {p.name for p in export_dir.iterdir()}
+        assert any("aaaaaaaaaaaa" in n for n in first), first
+
+        deserialized = []
+        from jax import export as jexport
+
+        real_deserialize = jexport.deserialize
+        monkeypatch.setattr(
+            jexport,
+            "deserialize",
+            lambda blob: (deserialized.append(1), real_deserialize(blob))[1],
+        )
+        # "another machine": different fingerprint, same sources/args
+        monkeypatch.setattr(cache_hardening, "_FINGERPRINT", "bbbbbbbbbbbb")
+        out = aot_cache.call("fp_test", fn, x)
+        assert (np.asarray(out) == x * 2 + 1).all()
+        assert not deserialized  # foreign artifact NOT loaded
+        second = {p.name for p in export_dir.iterdir()}
+        assert any("bbbbbbbbbbbb" in n for n in second)
+        assert first < second  # fresh export written alongside
+    finally:
+        cache_hardening._FINGERPRINT = None
+
+
+def test_conftest_cache_dir_is_machine_scoped():
+    """The test session itself must run against a machine-scoped XLA:CPU
+    cache (the MULTICHIP failure was cross-machine cache reuse)."""
+    d = jax.config.jax_compilation_cache_dir
+    assert f"mach-{cache_hardening.machine_fingerprint()}" in d

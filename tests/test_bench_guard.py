@@ -149,6 +149,158 @@ def test_flight_recorder_extra_present_in_results():
     assert "device_health" in rep["extra"]
 
 
+def _last_json(buf: str) -> dict:
+    for line in reversed(buf.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise AssertionError(f"no JSON in output: {buf!r}")
+
+
+def test_scenario_fault_degrades_one_scenario_not_the_run(monkeypatch, capsys):
+    """ISSUE 6 acceptance: a device fault in ONE scenario yields a
+    clearly-marked CPU-fallback datapoint for that scenario while every
+    other scenario (and the headline) survives — no whole-run -1."""
+    bench = _bench()
+    monkeypatch.setenv("TMTPU_BENCH_INPROC", "1")
+    monkeypatch.setenv("TMTPU_BENCH_SCENARIOS", "cfg_a,dead_b,extra_c")
+    monkeypatch.setenv("TMTPU_BENCH_BUDGET_S", "600")
+    monkeypatch.setattr(bench, "_CONFIG_SIZES", {"cfg_a": (8, None)})
+    fns = {
+        "cfg_a": lambda: {"n": 8, "tpu_e2e_ms": 1.25, "speedup_e2e": 2.0},
+        "dead_b": lambda: (_ for _ in ()).throw(
+            RuntimeError("injected device stall")
+        ),
+        "extra_c": lambda: {"blocks_per_sec": 42},
+    }
+    monkeypatch.setattr(bench, "_scenario_fns", lambda: fns)
+    monkeypatch.setattr(
+        bench,
+        "_cpu_fallback_fns",
+        lambda: {"dead_b": lambda: {"cpu_blocks_per_sec": 3}},
+    )
+    bench.main()
+    rep = _last_json(capsys.readouterr().out)
+    # headline survived the faulted scenario
+    assert rep["metric"] == "cfg_a_latency" and rep["value"] == 1.25
+    # the faulted scenario still emitted a parseable, clearly-marked datapoint
+    dead = rep["extra"]["dead_b"]
+    assert dead["degraded"] == "cpu-fallback"
+    assert "injected device stall" in dead["degrade_reason"]
+    assert dead["cpu_blocks_per_sec"] == 3
+    # unaffected scenarios ran normally
+    assert rep["extra"]["extra_c"] == {"blocks_per_sec": 42}
+
+
+def test_degraded_headline_is_marked_at_top_level(monkeypatch, capsys):
+    """When the only available headline is a CPU-fallback measurement, the
+    top-level JSON says so — a consumer tracking metric/value across rounds
+    must never mistake a host-loop number for a device datapoint."""
+    bench = _bench()
+    monkeypatch.setenv("TMTPU_BENCH_INPROC", "1")
+    monkeypatch.setenv("TMTPU_BENCH_SCENARIOS", "cfg_a")
+    monkeypatch.setenv("TMTPU_BENCH_BUDGET_S", "600")
+    monkeypatch.setattr(bench, "_CONFIG_SIZES", {"cfg_a": (8, None)})
+
+    def boom():
+        raise RuntimeError("device gone")
+
+    monkeypatch.setattr(bench, "_scenario_fns", lambda: {"cfg_a": boom})
+    monkeypatch.setattr(
+        bench,
+        "_cpu_fallback_fns",
+        lambda: {"cfg_a": lambda: {"n": 8, "tpu_e2e_ms": 9.9, "speedup_e2e": 1.0}},
+    )
+    bench.main()
+    rep = _last_json(capsys.readouterr().out)
+    assert rep["value"] == 9.9
+    assert rep["degraded"] == "cpu-fallback"
+    assert "device gone" in rep["degrade_reason"]
+    assert rep["extra"]["cfg_a"]["degraded"] == "cpu-fallback"
+
+
+def test_all_scenarios_failing_still_emits_every_datapoint(monkeypatch, capsys):
+    bench = _bench()
+    monkeypatch.setenv("TMTPU_BENCH_INPROC", "1")
+    monkeypatch.setenv("TMTPU_BENCH_SCENARIOS", "dead_a,dead_b")
+    monkeypatch.setenv("TMTPU_BENCH_BUDGET_S", "600")
+    monkeypatch.setattr(bench, "_CONFIG_SIZES", {})
+
+    def boom():
+        raise RuntimeError("tunnel down")
+
+    monkeypatch.setattr(
+        bench, "_scenario_fns", lambda: {"dead_a": boom, "dead_b": boom}
+    )
+    monkeypatch.setattr(bench, "_cpu_fallback_fns", lambda: {})
+    bench.main()
+    rep = _last_json(capsys.readouterr().out)
+    assert rep["value"] == -1  # no headline possible...
+    for name in ("dead_a", "dead_b"):  # ...but every scenario is accounted for
+        assert rep["extra"][name]["degraded"] == "cpu-fallback"
+        assert "tunnel down" in rep["extra"][name]["degrade_reason"]
+
+
+def test_bench_fault_hook_fires_for_named_scenario_only(monkeypatch, capsys):
+    bench = _bench()
+    monkeypatch.setenv("TMTPU_BENCH_INPROC", "1")
+    monkeypatch.setenv("TMTPU_BENCH_SCENARIOS", "selftest_fast")
+    monkeypatch.setenv("TMTPU_BENCH_FAULT", "selftest_fast:raise")
+    monkeypatch.setenv("TMTPU_BENCH_BUDGET_S", "600")
+    monkeypatch.setattr(bench, "_CONFIG_SIZES", {})
+    bench.main()
+    rep = _last_json(capsys.readouterr().out)
+    st = rep["extra"]["selftest_fast"]
+    assert st["degraded"] == "cpu-fallback"
+    assert "injected bench fault" in st["degrade_reason"]
+    # the degraded (CPU) retry must NOT re-fire the fault
+    assert "error" not in st
+
+
+def test_scenario_child_subprocess_protocol():
+    """One real scenario child: prints exactly one JSON line with the
+    scenario report, isolated in its own process."""
+    import subprocess
+
+    env = dict(
+        os.environ,
+        TMTPU_BENCH_SCENARIO="selftest_fast",
+        JAX_PLATFORMS="cpu",
+        TMTPU_CRYPTO_BACKEND="cpu",
+    )
+    p = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(_bench().__file__)),
+        env=env,
+        timeout=240,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rep = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rep["scenario"] == "selftest_fast"
+    assert rep["ok"] is True
+    assert rep["result"]["marker"] == "selftest"
+    assert "verify_stats" in rep["flight"]
+
+
+def test_help_documents_scenario_isolation_and_slope():
+    import subprocess
+
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--help"],
+        capture_output=True,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(_bench().__file__)),
+        timeout=120,
+    )
+    assert p.returncode == 0
+    assert "slope_samples" in p.stdout
+    assert "cpu-fallback" in p.stdout
+    assert "TMTPU_BENCH_FAULT" in p.stdout
+
+
 def test_guarded_main_emits_fallback_on_dead_child(tmp_path, monkeypatch):
     bench = _bench()
     stub = tmp_path / "dead_bench.py"
