@@ -123,6 +123,53 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+_HOST_STAMP = None
+
+
+def _host_stamp() -> dict:
+    """Host identity stamped on every emitted datapoint (and each scenario
+    child's JSON): machine fingerprint + git SHA + jax/jaxlib versions. The
+    r04→r05 AOT failures were cross-host artifact reuse that stayed
+    invisible precisely because BENCH json carried no host identity — the
+    perf ledger (tools/perf_ledger.py) keys trajectory comparisons on this."""
+    global _HOST_STAMP
+    if _HOST_STAMP is not None:
+        return _HOST_STAMP
+    import platform
+
+    out = {
+        "python": platform.python_version(),
+        "machine": platform.machine(),
+    }
+    try:
+        # no jax import needed: fingerprint reads cpuinfo + dist metadata
+        from tendermint_tpu.ops.cache_hardening import machine_fingerprint
+
+        out["machine_fingerprint"] = machine_fingerprint()
+    except Exception:
+        out["machine_fingerprint"] = None
+    from importlib import metadata
+
+    for dist in ("jax", "jaxlib"):
+        try:
+            out[dist] = metadata.version(dist)
+        except Exception:
+            pass
+    try:
+        import subprocess
+
+        sha = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+        out["git_sha"] = sha or None
+    except Exception:
+        out["git_sha"] = None
+    _HOST_STAMP = out
+    return out
+
+
 def make_batch(n: int, msg_len: int = 110, n_sr: int = 0):
     """n real signed (pubkey, msg, sig) triples, distinct keys, vote-sized
     msgs. The last n_sr rows are sr25519 (BASELINE config 5); the rest
@@ -256,6 +303,20 @@ def rlc_slope_samples(pubkeys, msgs, sigs, ks=(1, 2, 4, 8)):
     xs = np.array([s[0] for s in samples], dtype=np.float64)
     ys = np.array([s[1] for s in samples], dtype=np.float64)
     slope = float(((xs - xs.mean()) * (ys - ys.mean())).sum() / ((xs - xs.mean()) ** 2).sum())
+    try:
+        # expose the raw pairs through /debug/verify_stats too (they ride
+        # extra.verify_stats into the bench JSON from there): a suspicious
+        # slope is re-fittable from the stats read, no bench rerun
+        from tendermint_tpu.libs import trace as _tr
+
+        _tr.record_slope_samples(
+            samples,
+            slope_ms=slope * 1e3,
+            fused=bool(B.LAST_FLUSH_DETAIL.get("fused")),
+            source="bench",
+        )
+    except Exception:
+        pass
     return samples, slope * 1e3
 
 
@@ -977,6 +1038,18 @@ def watchdog(seconds: float):
     import signal
 
     def _fire(signum, frame):
+        try:
+            # a stage timeout is exactly when the diagnosis matters: write
+            # FORENSICS_*.json (wedged phase from the heartbeat, thread
+            # stacks, breaker/device state) before unwinding
+            from tendermint_tpu.libs import forensics as _forensics
+
+            _forensics.capture(
+                f"bench stage exceeded {seconds:.0f}s watchdog",
+                kind="timeout",
+            )
+        except Exception:
+            pass
         raise TimeoutError(f"bench stage exceeded {seconds:.0f}s watchdog")
 
     prev = signal.signal(signal.SIGALRM, _fire)
@@ -1129,10 +1202,31 @@ def scenario_main(name: str) -> None:
     ({"scenario", "ok", "result"|"error", "degraded"}), never hang past the
     in-process watchdogs (the parent's process-group deadline covers hard
     hangs)."""
+    from tendermint_tpu.libs import forensics as _forensics
     from tendermint_tpu.libs import trace as _trace
 
     degraded = os.environ.get("TMTPU_BENCH_DEGRADED") == "1"
-    out = {"scenario": name, "degraded": degraded}
+    out = {"scenario": name, "degraded": degraded, "host": _host_stamp()}
+    budget = float(os.environ.get("TMTPU_BENCH_SCENARIO_BUDGET_S", "600"))
+    # Stall forensics: heartbeat the device entry points + arm a watchdog
+    # THREAD that fires before the parent's hard process-group kill — a hard
+    # hang (SIGALRM unserviced, the BENCH_r05 mode) still leaves a
+    # FORENSICS_*.json naming the wedged phase for the parent to attach.
+    try:
+        _forensics.configure(
+            os.environ.get("TMTPU_FORENSICS_DIR") or os.getcwd()
+        )
+        _forensics.install_signal_handler()
+    except Exception:
+        pass
+    # budget is parent deadline minus 90 (_run_scenario_child), so +45 still
+    # fires 45 s BEFORE the parent's hard process-group kill — device init
+    # shares the window, it has no extra allowance here
+    hard_wd = _forensics.Watchdog(
+        budget + 45.0,
+        f"bench scenario {name!r} wedged past its {budget:.0f}s budget",
+        extra={"scenario": name},
+    ).start()
     try:
         import jax
 
@@ -1143,7 +1237,6 @@ def scenario_main(name: str) -> None:
                 _apply_bench_fault(name)
             log(f"[{name}] devices:", jax.devices())
             _trace.record_device_init(time.perf_counter() - t_init, ok=True)
-        budget = float(os.environ.get("TMTPU_BENCH_SCENARIO_BUDGET_S", "600"))
         fns = _cpu_fallback_fns() if degraded else _scenario_fns()
         if degraded and name not in fns:
             out["ok"] = True
@@ -1155,6 +1248,7 @@ def scenario_main(name: str) -> None:
     except BaseException as e:  # noqa: BLE001 — the child must still report
         out["ok"] = False
         out["error"] = f"{type(e).__name__}: {e}"
+    hard_wd.cancel()
     out["flight"] = _flight_recorder_extra()
     print(json.dumps(out), flush=True)
 
@@ -1168,6 +1262,35 @@ def _parse_scenario_json(out: str, name: str):
         if isinstance(rep, dict) and rep.get("scenario") == name:
             return rep
     return None
+
+
+def _forensics_for_kill(t_child_start: float) -> dict:
+    """Attach a killed scenario child's stall diagnosis to the parent's
+    report: FORENSICS_*.json files written since the child started (by its
+    in-child watchdog thread or the parent's SIGUSR1 request), plus the
+    wedged phase named by the newest one — so a hard-deadline kill reports
+    WHICH device phase wedged instead of a bare timeout."""
+    from tendermint_tpu.libs import forensics as _forensics
+
+    out: dict = {}
+    try:
+        d = os.environ.get("TMTPU_FORENSICS_DIR") or os.getcwd()
+        # small rewind: the capture's mtime can predate communicate()'s
+        # timeout bookkeeping by the watchdog margin
+        paths = _forensics.find_captures(d, since_ts=t_child_start - 1.0)
+    except Exception:
+        return out
+    if not paths:
+        return out
+    out["forensics"] = paths
+    try:
+        with open(paths[-1]) as f:
+            doc = json.load(f)
+        out["wedged_phase"] = doc.get("wedged_phase")
+        out["forensics_kind"] = doc.get("kind")
+    except (OSError, ValueError):
+        pass
+    return out
 
 
 def _run_scenario_child(name: str, deadline_s: float, degraded: bool = False,
@@ -1212,6 +1335,7 @@ def _run_scenario_child(name: str, deadline_s: float, degraded: bool = False,
             TMTPU_CRYPTO_BACKEND="cpu",
             TMTPU_SHARDED="0",
         )
+    t_child_start = time.time()
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         env=env,
@@ -1221,6 +1345,16 @@ def _run_scenario_child(name: str, deadline_s: float, degraded: bool = False,
     try:
         raw, _ = proc.communicate(timeout=deadline_s)
     except subprocess.TimeoutExpired:
+        # last-chance diagnosis request before the kill: SIGUSR1 triggers
+        # the child's forensics dump IF its interpreter still runs Python
+        # (the in-child watchdog thread covers the hard-hang case)
+        try:
+            os.killpg(proc.pid, _signal.SIGUSR1)
+            # grace must exceed the signal capture's worst case (stack dump
+            # + fingerprint + JSON write; it skips the 2 s device probe)
+            time.sleep(3.0)
+        except (OSError, AttributeError):
+            pass
         try:
             os.killpg(proc.pid, _signal.SIGKILL)
         except OSError:
@@ -1232,11 +1366,13 @@ def _run_scenario_child(name: str, deadline_s: float, degraded: bool = False,
         rep = _parse_scenario_json(raw.decode(errors="replace"), name)
         if rep is not None:
             return rep  # printed its result, then hung in teardown
-        return {
+        rep = {
             "scenario": name,
             "ok": False,
             "error": f"scenario child exceeded {deadline_s:.0f}s hard deadline",
         }
+        rep.update(_forensics_for_kill(t_child_start))
+        return rep
     rep = _parse_scenario_json(raw.decode(errors="replace"), name)
     if rep is None:
         return {
@@ -1343,6 +1479,7 @@ def main():
         sn = extra["streaming"].get("n")
         if sps is not None and sn is not None:
             extra[f"streaming_{sn}_sigs_per_sec"] = sps
+    extra["host"] = _host_stamp()
     rep = {
         "metric": f"{name}_latency",
         "value": res["tpu_e2e_ms"],
@@ -1386,6 +1523,10 @@ def _emit_fallback(err: str, scenario_extra: dict | None = None) -> None:
     extra = dict(scenario_extra or {})
     extra["error"] = err
     extra.update(_flight_recorder_extra())
+    try:  # a lost datapoint still names the host it was lost on
+        extra["host"] = _host_stamp()
+    except Exception:
+        pass
     print(json.dumps({"metric": "verify_commit_latency", "value": -1,
                       "unit": "ms", "vs_baseline": 0, "extra": extra}))
 
@@ -1402,6 +1543,42 @@ def _salvage_json(out: str) -> bool:
         print(line)
         return True
     return False
+
+
+def _profile_main(name: str, base_dir: str | None = None, top: int = 25) -> int:
+    """`bench.py --profile <scenario>`: run ONE scenario in-process inside a
+    device profiler capture (libs/profiler.py) and render the per-stage /
+    per-kernel attribution table (tools/profile_report.py) on stdout — the
+    PERF.md round-4 afternoon of perfetto spelunking as one command. This is
+    an interactive attribution tool, not a datapoint emitter: the one-JSON-
+    line contract does not apply, and nothing here runs under the scenario
+    watchdogs (a profile of a wedge is best taken with --profile + ctrl-C
+    anyway, the partial capture survives in the run dir)."""
+    from tendermint_tpu.libs import profiler
+    from tendermint_tpu.tools import profile_report
+
+    _configure_caches()
+    fns = _scenario_fns()
+    if name not in fns:
+        log(f"--profile: unknown scenario {name!r}; choose from: "
+            + ", ".join(sorted(fns)))
+        return 2
+    import jax
+
+    log(f"[profile:{name}] devices: {jax.devices()}")
+    info = profiler.start(base_dir)
+    log(f"[profile:{name}] capturing into {info['dir']}")
+    try:
+        result = fns[name]()
+    finally:
+        cap = profiler.stop()
+    log(f"[profile:{name}] {len(cap['artifacts'])} artifact(s), "
+        f"{cap['duration_s']}s captured")
+    rep = profile_report.report(cap["dir"], top=top)
+    rep["scenario"] = {"name": name, "result": result, "host": _host_stamp()}
+    sys.stdout.write(profile_report.render_markdown(rep))
+    print(f"\ncapture dir: {cap['dir']}")
+    return 0
 
 
 def guarded_main():
@@ -1460,8 +1637,27 @@ if __name__ == "__main__":
     # `extra.verify_stats` / `extra.device_health` breakdown contract.
     # parse_known_args: unknown argv must not exit(2) before the one-JSON-
     # line contract (guarded_main/_emit_fallback) can be honored.
-    argparse.ArgumentParser(
+    _ap = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
-    ).parse_known_args()
+    )
+    _ap.add_argument(
+        "--profile", metavar="SCENARIO",
+        help="run ONE scenario inside a device profiler capture and print "
+             "the per-stage attribution table (tools/profile_report.py) "
+             "instead of the bench JSON line",
+    )
+    _ap.add_argument(
+        "--profile-dir", metavar="DIR",
+        help="capture base directory (default: tmtpu_profiles under tmp)",
+    )
+    _ap.add_argument(
+        "--profile-top", type=int, default=25, metavar="N",
+        help="top-N ops in the --profile table (default 25)",
+    )
+    _args, _ = _ap.parse_known_args()
+    if _args.profile:
+        raise SystemExit(
+            _profile_main(_args.profile, _args.profile_dir, _args.profile_top)
+        )
     guarded_main()

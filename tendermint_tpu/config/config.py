@@ -284,6 +284,15 @@ class InstrumentationConfig:
     # kept for GET /debug/consensus_timeline and post-mortem diffing against
     # `wal-inspect`. Node-local; recording follows trace_enabled.
     timeline_heights: int = 128
+    # On-demand profiler captures (libs/profiler.py via
+    # GET /debug/device_profile) write run dirs here; empty = a tmtpu_profiles
+    # dir under the system temp dir.
+    profile_dir: str = ""
+    # Stall forensics (libs/forensics.py): when set, device entry points
+    # heartbeat phase stamps into an mmap'd ring under this dir and
+    # FORENSICS_*.json captures land there. Empty = disabled (the
+    # TMTPU_FORENSICS_DIR env default still applies).
+    forensics_dir: str = ""
 
 
 @dataclass

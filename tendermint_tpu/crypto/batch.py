@@ -38,6 +38,7 @@ import numpy as np
 
 from tendermint_tpu.crypto.circuit_breaker import VerifyCircuitBreaker
 from tendermint_tpu.crypto.ed25519_ref import L
+from tendermint_tpu.libs import forensics as _forensics
 from tendermint_tpu.libs import trace as _trace
 
 L8 = 8 * L  # full curve-group order; scalar modulus for torsion-exact RLC
@@ -67,6 +68,12 @@ def set_device_fault_hook(fn) -> None:
 
 
 def _device_fault(site: str) -> None:
+    # Forensics heartbeat FIRST: the phase stamp must land before anything
+    # that can hang (the injected hook below models exactly that), so a
+    # wedged flush leaves its phase in the mmap'd ring for the watchdog /
+    # bench parent to read (libs/forensics.py). One None check when
+    # forensics is not configured.
+    _forensics.beat(site)
     hook = _DEVICE_FAULT_HOOK
     if hook is not None:
         hook(site)
@@ -985,6 +992,11 @@ def _verify_batch_rlc_sharded(
         padded = -(-2 * na // target) * target
         if 4 * padded <= 5 * (2 * na):
             na = padded // 2
+    # Mesh telemetry: the padding decision happens HERE (sharded.py only
+    # ever sees padded arrays), so the pad-waste fraction is recorded here.
+    from tendermint_tpu.parallel import telemetry as _mesh_tm
+
+    _mesh_tm.record_pad(requested_lanes=2 * n + 1, padded_lanes=2 * na)
     b_enc = np.frombuffer(point_compress(BASE), dtype=np.uint8)
     pts = np.tile(b_enc, (2 * na, 1))
     if precheck.any():

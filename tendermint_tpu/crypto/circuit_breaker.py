@@ -223,6 +223,11 @@ class VerifyCircuitBreaker:
                 self._metrics().breaker_probes.labels("pass" if ok else "fail").inc()
             except Exception:
                 pass
+            open_for = (
+                round(self._clock() - self._opened_at, 3)
+                if self._opened_at is not None
+                else None
+            )
             if ok:
                 logger.warning(
                     "verify-path circuit breaker: health probe passed — "
@@ -235,6 +240,24 @@ class VerifyCircuitBreaker:
                     self._probe_backoff * 2, self.probe_interval_max
                 )
                 self._set_state_locked(OPEN)
+            next_backoff = self._probe_backoff
+        # Flight-recorder events (same ring as the flush spans they explain:
+        # /debug/trace interleaves breaker history with the degraded flushes)
+        try:
+            from tendermint_tpu.libs.trace import tracer
+
+            if tracer.enabled:
+                if ok:
+                    tracer.event("breaker.rearm", open_for_s=open_for)
+                else:
+                    tracer.event(
+                        "breaker.probe_fail",
+                        reason=err or None,
+                        next_backoff_s=next_backoff,
+                        open_for_s=open_for,
+                    )
+        except Exception:
+            pass
         return ok
 
     def _start_probe_thread_locked(self) -> None:

@@ -1,0 +1,459 @@
+"""Stall forensics: phase heartbeats + a post-mortem capture for wedged flushes.
+
+Every MULTICHIP round to date died opaquely — rc-124 timeouts and AOT
+mismatches with no record of WHICH device phase wedged — and BENCH_r05 lost
+its whole datapoint to a "device initialization stalled" with zero stage
+attribution. The failure mode is always the same shape: a device entry point
+(submit, finish/sync, probe) blocks in C and never returns, so in-process
+watchdogs that rely on the wedged thread (SIGALRM handlers, deadline checks
+on the flush path itself) never run either.
+
+This module attacks that with two pieces that DON'T depend on the wedged
+thread:
+
+1. **Heartbeat** — a tiny mmap'd ring file. Each device entry point
+   (`crypto/batch._device_fault`, which the chaos hook already enumerates:
+   rlc_submit / rlc_finish / persig / probe — plus the sharded mesh entry
+   points) stamps `(seq, monotonic, wall, pid, phase)` into the ring BEFORE
+   touching the device. When the process wedges, the newest stamp names the
+   phase it wedged in; because the file is mmap'd, an outside reader (the
+   bench parent, an operator, a post-mortem) reads it even while — or after —
+   the writer hangs. Overhead contract: with no heartbeat configured the
+   hot-path `beat()` is one module-global None check.
+
+2. **Watchdog + capture** — a daemon thread armed with a deadline. If not
+   cancelled in time it calls `capture()`, which assembles a
+   `FORENSICS_<stamp>_<pid>.json`: the wedged phase (newest heartbeat), the
+   heartbeat tail, every thread's stack (faulthandler, readable even when the
+   main thread is stuck in C), the verify-path circuit-breaker snapshot,
+   device health from the flight recorder, a bounded-time `jax.devices()`
+   probe (its own hang IS the diagnosis), and the machine fingerprint.
+   bench.py arms one per scenario child so a hard hang yields a diagnosis
+   file before the parent's process-group SIGKILL; `install_signal_handler`
+   additionally lets the parent request a dump with SIGUSR1.
+
+File format (`Heartbeat`): 16-byte header `TMHB1\\0 | u16 slots | u64 next
+seq`, then `slots` fixed 64-byte records `u64 seq | f64 monotonic | f64
+wall | u32 pid | 36s phase`. Readers sort by seq and ignore empty slots, so
+a torn in-flight write costs at most one beat.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_MAGIC = b"TMHB1\x00"
+_HEADER = struct.Struct("<6sHQ")  # magic, slot count, next seq
+_RECORD = struct.Struct("<QddI36s")  # seq, monotonic, wall, pid, phase
+SLOT_SIZE = 64
+assert _RECORD.size <= SLOT_SIZE
+DEFAULT_SLOTS = 64
+
+
+class Heartbeat:
+    """Writer half: stamp phases into the mmap'd ring. One instance per
+    process (module-global via `configure`); thread-safe."""
+
+    def __init__(self, path: str, slots: int = DEFAULT_SLOTS):
+        self.path = path
+        self.slots = max(1, int(slots))
+        self._lock = threading.Lock()
+        self._seq = 0
+        size = _HEADER.size + self.slots * SLOT_SIZE
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # O_CREAT without O_TRUNC: re-opening an existing file continues its
+        # sequence (a restarted process appends history instead of erasing
+        # the pre-crash tail an investigator may still want)
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            if os.fstat(fd).st_size < size:
+                os.ftruncate(fd, size)
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        magic, slots_on_disk, seq = _HEADER.unpack_from(self._mm, 0)
+        if magic == _MAGIC and slots_on_disk == self.slots:
+            self._seq = seq
+        else:
+            _HEADER.pack_into(self._mm, 0, _MAGIC, self.slots, 0)
+
+    def beat(self, phase: str) -> None:
+        b = phase.encode()[:36]
+        now_m, now_w = time.monotonic(), time.time()
+        with self._lock:
+            self._seq += 1
+            slot = (self._seq - 1) % self.slots
+            _RECORD.pack_into(
+                self._mm,
+                _HEADER.size + slot * SLOT_SIZE,
+                self._seq, now_m, now_w, os.getpid(), b,
+            )
+            _HEADER.pack_into(self._mm, 0, _MAGIC, self.slots, self._seq)
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except Exception:
+            pass
+
+    @staticmethod
+    def read(path: str, limit: Optional[int] = None) -> List[dict]:
+        """Reader half: beats oldest-first (the newest names the wedged
+        phase). Safe against a concurrently-writing — or hung — writer."""
+        with open(path, "rb") as f:
+            buf = f.read()
+        if len(buf) < _HEADER.size:
+            return []
+        magic, slots, _seq = _HEADER.unpack_from(buf, 0)
+        if magic != _MAGIC:
+            raise ValueError(f"{path}: not a heartbeat file")
+        out = []
+        now_w = time.time()
+        for i in range(slots):
+            off = _HEADER.size + i * SLOT_SIZE
+            if off + _RECORD.size > len(buf):
+                break
+            seq, mono, wall, pid, phase = _RECORD.unpack_from(buf, off)
+            if seq == 0:
+                continue
+            out.append(
+                {
+                    "seq": seq,
+                    "phase": phase.split(b"\x00", 1)[0].decode(errors="replace"),
+                    "wall_ts": round(wall, 6),
+                    "age_s": round(now_w - wall, 3),
+                    "pid": pid,
+                }
+            )
+        out.sort(key=lambda r: r["seq"])
+        if limit is not None and limit >= 0:
+            out = out[-limit:] if limit else []
+        return out
+
+
+# -- module-global writer (the hot-path surface) ------------------------------
+
+_HB: Optional[Heartbeat] = None
+_HB_LOCK = threading.Lock()
+_OUT_DIR: Optional[str] = None
+_CAPTURE_SEQ = 0
+
+
+def configure(directory: Optional[str], slots: int = DEFAULT_SLOTS) -> Optional[str]:
+    """Enable (or with None disable) the process heartbeat under `directory`.
+    Returns the heartbeat file path. Also sets the default FORENSICS_*.json
+    output directory. Wired from `[instrumentation] forensics_dir`
+    (node/node.py), the TMTPU_FORENSICS_DIR env default, and bench.py's
+    scenario children."""
+    global _HB, _OUT_DIR
+    with _HB_LOCK:
+        if _HB is not None:
+            _HB.close()
+            _HB = None
+        if not directory:
+            _OUT_DIR = None
+            return None
+        _OUT_DIR = directory
+        _HB = Heartbeat(
+            os.path.join(directory, f"heartbeat_{os.getpid()}.bin"), slots
+        )
+        return _HB.path
+
+
+def enabled() -> bool:
+    return _HB is not None
+
+
+def heartbeat_path() -> Optional[str]:
+    hb = _HB
+    return hb.path if hb is not None else None
+
+
+def beat(phase: str) -> None:
+    """Stamp a phase. ONE None check when forensics is not configured — safe
+    on the device hot path (crypto/batch._device_fault)."""
+    hb = _HB
+    if hb is not None:
+        hb.beat(phase)
+
+
+def _heartbeat_tail(limit: int = 16) -> List[dict]:
+    hb = _HB
+    if hb is None:
+        return []
+    try:
+        return Heartbeat.read(hb.path, limit)
+    except Exception:
+        return []
+
+
+# -- capture ------------------------------------------------------------------
+
+
+def _thread_stacks() -> str:
+    """Every thread's stack. faulthandler first (it walks the interpreter
+    state in C, so it renders a thread wedged inside a C call); pure-Python
+    fallback if faulthandler can't write."""
+    import tempfile
+
+    try:
+        import faulthandler
+
+        with tempfile.TemporaryFile(mode="w+") as f:
+            faulthandler.dump_traceback(file=f, all_threads=True)
+            f.seek(0)
+            return f.read()
+    except Exception:
+        pass
+    import traceback
+
+    chunks = []
+    for tid, frame in sys._current_frames().items():
+        chunks.append(f"Thread {tid}:\n" + "".join(traceback.format_stack(frame)))
+    return "\n".join(chunks)
+
+
+def _probe_jax_devices(timeout_s: float = 2.0) -> dict:
+    """`jax.devices()` health, probed from a side thread with a deadline —
+    in the observed failure mode (BENCH_r05) the call itself never returns,
+    and that non-return is exactly what the forensics file should say."""
+    result: Dict[str, Any] = {}
+
+    def _probe():
+        try:
+            import jax
+
+            result["devices"] = [str(d) for d in jax.devices()]
+            result["backend"] = jax.default_backend()
+        except Exception as e:  # no jax / broken backend: still a diagnosis
+            result["error"] = repr(e)
+
+    t = threading.Thread(target=_probe, name="forensics-jax-probe", daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return {"error": f"jax.devices() did not return within {timeout_s:g}s"}
+    return result
+
+
+def capture(
+    reason: str,
+    *,
+    kind: str = "manual",
+    wedged_phase: Optional[str] = None,
+    extra: Optional[dict] = None,
+    out_dir: Optional[str] = None,
+    probe_devices: bool = True,
+) -> str:
+    """Assemble and write a FORENSICS_<stamp>_<pid>.json; returns its path.
+
+    Never raises past its own boundary and never depends on the wedged
+    thread: every section degrades to an error string independently. `kind`
+    labels the metrics counter (watchdog / signal / timeout / manual)."""
+    ts = time.time()
+    tail = _heartbeat_tail()
+    doc: Dict[str, Any] = {
+        "reason": reason,
+        "kind": kind,
+        "ts": round(ts, 3),
+        "pid": os.getpid(),
+        "argv": sys.argv,
+        "wedged_phase": wedged_phase
+        or (tail[-1]["phase"] if tail else None),
+        "heartbeat": tail,
+        "heartbeat_file": heartbeat_path(),
+    }
+    try:
+        from tendermint_tpu.ops.cache_hardening import machine_fingerprint
+
+        doc["machine_fingerprint"] = machine_fingerprint()
+    except Exception as e:
+        doc["machine_fingerprint"] = f"error: {e!r}"
+    try:
+        doc["threads"] = _thread_stacks()
+    except Exception as e:
+        doc["threads"] = f"error: {e!r}"
+    try:
+        from tendermint_tpu.crypto.batch import BREAKER, LAST_FLUSH_DETAIL
+
+        doc["breaker"] = BREAKER.snapshot()
+        doc["last_flush_detail"] = dict(LAST_FLUSH_DETAIL)
+    except Exception as e:
+        doc["breaker"] = f"error: {e!r}"
+    try:
+        from tendermint_tpu.libs import trace as _trace
+
+        doc["device_health"] = _trace.device_health()
+    except Exception as e:
+        doc["device_health"] = f"error: {e!r}"
+    doc["jax"] = _probe_jax_devices() if probe_devices else {"skipped": True}
+    if extra:
+        doc["extra"] = extra
+
+    d = out_dir or _OUT_DIR or os.getcwd()
+    stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime(ts))
+    with _HB_LOCK:
+        global _CAPTURE_SEQ
+        _CAPTURE_SEQ += 1
+        seq = _CAPTURE_SEQ
+    # pid + per-process seq: two captures in the same second (watchdog +
+    # signal racing, say) must not overwrite each other
+    path = os.path.join(d, f"FORENSICS_{stamp}_{os.getpid()}_{seq}.json")
+    try:
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=repr)
+        os.replace(tmp, path)
+    except Exception:
+        # last resort: the diagnosis still reaches the scenario log
+        print(json.dumps(doc, default=repr), file=sys.stderr, flush=True)
+    try:
+        from tendermint_tpu.libs import metrics as _metrics
+
+        _metrics.observatory_metrics().forensics_captures.labels(kind).inc()
+    except Exception:
+        pass
+    try:
+        from tendermint_tpu.libs.trace import tracer
+
+        if tracer.enabled:
+            tracer.event(
+                "forensics.capture",
+                reason=reason,
+                kind=kind,
+                wedged_phase=doc["wedged_phase"],
+                path=path,
+            )
+    except Exception:
+        pass
+    return path
+
+
+def find_captures(directory: str, since_ts: float = 0.0) -> List[str]:
+    """FORENSICS_*.json files under `directory` newer than `since_ts`,
+    oldest first (the bench parent attaches these to a killed scenario's
+    error report)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for n in sorted(names):
+        if n.startswith("FORENSICS_") and n.endswith(".json"):
+            p = os.path.join(directory, n)
+            try:
+                if os.path.getmtime(p) >= since_ts:
+                    out.append(p)
+            except OSError:
+                pass
+    return out
+
+
+class Watchdog:
+    """Fire `capture()` if not cancelled within `timeout_s`.
+
+    A daemon THREAD, deliberately not a signal: the observed hangs block the
+    main thread inside C without servicing SIGALRM, while a side thread
+    still runs (the tunnel waits release the GIL). Arm it around anything
+    that can wedge — bench.py arms one per scenario child just inside the
+    parent's hard process-group deadline."""
+
+    def __init__(
+        self,
+        timeout_s: float,
+        reason: str,
+        *,
+        out_dir: Optional[str] = None,
+        extra: Optional[dict] = None,
+        on_fire=None,
+    ):
+        self.timeout_s = float(timeout_s)
+        self.reason = reason
+        self.out_dir = out_dir
+        self.extra = extra
+        self.on_fire = on_fire
+        self.fired = False
+        self.capture_path: Optional[str] = None
+        self._cancel = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Watchdog":
+        t = threading.Thread(
+            target=self._run, name="forensics-watchdog", daemon=True
+        )
+        self._thread = t
+        t.start()
+        return self
+
+    def _run(self) -> None:
+        if self._cancel.wait(self.timeout_s):
+            return
+        self.fired = True
+        try:
+            self.capture_path = capture(
+                self.reason,
+                kind="watchdog",
+                out_dir=self.out_dir,
+                extra=self.extra,
+            )
+        finally:
+            if self.on_fire is not None:
+                try:
+                    self.on_fire(self)
+                except Exception:
+                    pass
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.cancel()
+
+
+def install_signal_handler(signum: Optional[int] = None) -> bool:
+    """Dump forensics on demand from OUTSIDE the process (default SIGUSR1):
+    the bench parent signals a timed-out child and waits briefly for the
+    FORENSICS file before the SIGKILL. Best-effort — a main thread wedged in
+    C that never re-enters the interpreter cannot run Python signal
+    handlers; the Watchdog covers that case."""
+    import signal
+
+    if signum is None:
+        signum = getattr(signal, "SIGUSR1", None)
+        if signum is None:  # pragma: no cover - non-POSIX
+            return False
+
+    def _handler(_sig, _frame):
+        # no device probe here: the parent SIGKILLs a few seconds after the
+        # signal, and the probe's join window would eat the whole grace
+        # period exactly when the device is wedged (the watchdog path, with
+        # no kill racing it, still probes)
+        capture("signal-requested dump", kind="signal", probe_devices=False)
+
+    try:
+        signal.signal(signum, _handler)
+        return True
+    except (ValueError, OSError):  # not the main thread, or unsupported
+        return False
+
+
+# Env default, mirroring TMTPU_TRACE: a process started with
+# TMTPU_FORENSICS_DIR set heartbeats (and writes captures) there without any
+# explicit configure() call.
+_env_dir = os.environ.get("TMTPU_FORENSICS_DIR")
+if _env_dir:
+    try:
+        configure(_env_dir)
+    except Exception:  # never fail an import over forensics plumbing
+        pass

@@ -641,6 +641,80 @@ class PubSubMetrics:
         )
 
 
+class MeshMetrics:
+    """Multi-chip mesh telemetry (parallel/sharded.py via
+    parallel/telemetry.py). No reference counterpart — the reference has no
+    device mesh. These series exist because every MULTICHIP round to date
+    failed with zero per-shard evidence (ROADMAP item 2); the fed values
+    come from the sharded submit/finish wrappers and the AOT artifact
+    cache."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_mesh"
+        self.devices = reg.gauge(
+            f"{ns}_devices", "Devices in the active sharding mesh."
+        )
+        self.shard_lanes = reg.gauge(
+            f"{ns}_shard_lanes",
+            "Lanes per device shard in the last sharded flush.",
+            ("device",),
+        )
+        self.pad_waste_fraction = reg.gauge(
+            f"{ns}_pad_waste_fraction",
+            "Fraction of lanes that were padding in the last sharded flush.",
+        )
+        self.flushes = reg.counter(
+            f"{ns}_flushes_total", "Sharded device flushes.", ("kind",)
+        )
+        self.submit_seconds = reg.counter(
+            f"{ns}_submit_seconds_total",
+            "Wall seconds dispatching sharded programs (host-side submit).",
+        )
+        self.finish_seconds = reg.counter(
+            f"{ns}_finish_seconds_total",
+            "Wall seconds blocked syncing sharded results (D2H + stragglers).",
+        )
+        self.all_gathers = reg.counter(
+            f"{ns}_all_gathers_total",
+            "Cross-chip all_gather collectives issued by sharded flushes.",
+        )
+        self.all_gather_bytes = reg.counter(
+            f"{ns}_all_gather_bytes_total",
+            "Logical bytes moved by sharded all_gather collectives.",
+        )
+        self.prep_seconds = reg.counter(
+            f"{ns}_prep_seconds_total",
+            "Host wall seconds in per-shard RLC prep (window sort + bounds).",
+        )
+        self.aot_cache = reg.counter(
+            f"{ns}_aot_cache_total",
+            "AOT artifact-cache outcomes (hit=deserialized, miss=fresh "
+            "export, corrupt=deleted+re-exported); machine-scoped keys make "
+            "a foreign host's artifacts misses, never loader failures.",
+            ("result",),
+        )
+
+
+class ObservatoryMetrics:
+    """Profiler-capture and stall-forensics accounting (libs/profiler.py,
+    libs/forensics.py): how often the observatory itself was used — a
+    FORENSICS capture incrementing here is the signal a round hit a hard
+    hang and left a diagnosis file behind."""
+
+    def __init__(self, reg: Registry):
+        self.profiler_actions = reg.counter(
+            f"{NAMESPACE}_profiler_actions_total",
+            "Profiler session actions (start/stop/trace_function).",
+            ("action",),
+        )
+        self.forensics_captures = reg.counter(
+            f"{NAMESPACE}_forensics_captures_total",
+            "FORENSICS_*.json captures written, by trigger "
+            "(watchdog/signal/timeout/manual).",
+            ("kind",),
+        )
+
+
 class ChaosMetrics:
     """tendermint_tpu/chaos engine accounting: how many faults a soak/smoke
     injected per level. Exposed so a chaos run's /metrics scrape shows the
@@ -663,16 +737,21 @@ _GLOBAL_REGISTRY: Optional[Registry] = None
 _BATCH_METRICS: Optional[BatchVerifyMetrics] = None
 _PUBSUB_METRICS: Optional[PubSubMetrics] = None
 _CHAOS_METRICS: Optional[ChaosMetrics] = None
+_MESH_METRICS: Optional[MeshMetrics] = None
+_OBSERVATORY_METRICS: Optional[ObservatoryMetrics] = None
 
 
 def global_registry() -> Registry:
     global _GLOBAL_REGISTRY, _BATCH_METRICS, _PUBSUB_METRICS, _CHAOS_METRICS
+    global _MESH_METRICS, _OBSERVATORY_METRICS
     with _GLOBAL_LOCK:
         if _GLOBAL_REGISTRY is None:
             _GLOBAL_REGISTRY = Registry()
             _BATCH_METRICS = BatchVerifyMetrics(_GLOBAL_REGISTRY)
             _PUBSUB_METRICS = PubSubMetrics(_GLOBAL_REGISTRY)
             _CHAOS_METRICS = ChaosMetrics(_GLOBAL_REGISTRY)
+            _MESH_METRICS = MeshMetrics(_GLOBAL_REGISTRY)
+            _OBSERVATORY_METRICS = ObservatoryMetrics(_GLOBAL_REGISTRY)
         return _GLOBAL_REGISTRY
 
 
@@ -689,6 +768,16 @@ def pubsub_metrics() -> PubSubMetrics:
 def chaos_metrics() -> ChaosMetrics:
     global_registry()
     return _CHAOS_METRICS
+
+
+def mesh_metrics() -> MeshMetrics:
+    global_registry()
+    return _MESH_METRICS
+
+
+def observatory_metrics() -> ObservatoryMetrics:
+    global_registry()
+    return _OBSERVATORY_METRICS
 
 
 class NodeMetrics:

@@ -1,0 +1,210 @@
+"""On-demand TPU/device profiler capture (a thin jax.profiler session wrapper).
+
+PERF.md's round-4 per-stage device attribution came from a one-off manual
+perfetto trace — an afternoon of ad-hoc scripting that no later round
+repeated, which is why the fused-MSM work (PR 6) shipped with CPU-only
+evidence. This module makes capture a first-class operation with three
+entry points:
+
+- `GET /debug/device_profile?action=start|stop|status` (rpc/server.py): an
+  operator profiles a LIVE node's flushes without restarting it;
+- `bench.py --profile <scenario>`: one command captures a scenario and
+  renders the per-stage table (tools/profile_report.py);
+- `trace_function(fn, *args)`: one-flush capture for tests/tools.
+
+A capture session is PROCESS-GLOBAL (jax.profiler supports one active trace
+per process) and writes into a fresh run directory
+`<base>/tmtpu_profile_<utcstamp>_<pid>_<seq>/`; jax drops the TensorBoard-layout
+artifacts under `plugins/profile/<ts>/` — a `*.xplane.pb` (always) and a
+`*.trace.json.gz` (perfetto/chrome form). `tools/profile_report.py` parses
+either into a per-kernel / per-fused-stage (uptree, fenwick_reduce,
+bucket_fold, persig) time table.
+
+CPU-backend caveat (docs/OBSERVABILITY.md): on `JAX_PLATFORMS=cpu` the
+capture contains host Python spans, XLA:CPU compile passes and runtime
+thunks, but no device plane — stage attribution of the *device* kind needs
+a real accelerator. The capture/report PIPELINE is identical on both, which
+is what the tier-1 round-trip test pins.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class ProfilerError(RuntimeError):
+    """start when active / stop when idle / profiler unavailable."""
+
+
+_LOCK = threading.Lock()
+_STATE: Dict[str, Any] = {
+    "active": False,
+    "dir": None,
+    "started_at": None,
+    "last_capture": None,  # {"dir", "started_at", "stopped_at", "artifacts"}
+}
+_RUN_SEQ = 0  # uniquifies run dirs within one wall-clock second
+
+
+def default_base_dir() -> str:
+    return os.path.join(tempfile.gettempdir(), "tmtpu_profiles")
+
+
+def _artifacts(run_dir: str) -> list:
+    """Capture artifacts under a run dir, relative paths + sizes."""
+    out = []
+    for pat in ("**/*.xplane.pb", "**/*.trace.json.gz", "**/*.json.gz"):
+        for p in glob.glob(os.path.join(run_dir, pat), recursive=True):
+            rel = os.path.relpath(p, run_dir)
+            if not any(a["file"] == rel for a in out):
+                try:
+                    size = os.path.getsize(p)
+                except OSError:
+                    size = None
+                out.append({"file": rel, "bytes": size})
+    return sorted(out, key=lambda a: a["file"])
+
+
+def _metrics_inc(action: str) -> None:
+    try:
+        from tendermint_tpu.libs import metrics as _metrics
+
+        _metrics.observatory_metrics().profiler_actions.labels(action).inc()
+    except Exception:
+        pass
+
+
+def start(base_dir: Optional[str] = None) -> dict:
+    """Begin a capture into a fresh run directory; returns {"dir", ...}.
+    Raises ProfilerError if a capture is already active (jax supports one
+    trace per process) or the profiler backend is unavailable."""
+    import jax
+
+    with _LOCK:
+        if _STATE["active"]:
+            raise ProfilerError(
+                f"profiler capture already active (dir={_STATE['dir']})"
+            )
+        global _RUN_SEQ
+        _RUN_SEQ += 1
+        # pid+seq suffix: two captures in the same wall-clock second (easy
+        # with sub-second trace_function calls) must not share a run dir —
+        # _artifacts() and profile_report would silently merge their events
+        run_dir = os.path.join(
+            base_dir or default_base_dir(),
+            time.strftime("tmtpu_profile_%Y%m%d_%H%M%S", time.gmtime())
+            + f"_{os.getpid()}_{_RUN_SEQ}",
+        )
+        os.makedirs(run_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(run_dir)
+        except Exception as e:
+            raise ProfilerError(f"jax.profiler.start_trace failed: {e!r}") from e
+        _STATE.update(active=True, dir=run_dir, started_at=time.time())
+    _metrics_inc("start")
+    try:
+        from tendermint_tpu.libs.trace import tracer
+
+        if tracer.enabled:
+            tracer.event("profiler.start", dir=run_dir)
+    except Exception:
+        pass
+    return {"active": True, "dir": run_dir, "backend": jax.default_backend()}
+
+
+def stop() -> dict:
+    """End the active capture; returns {"dir", "artifacts", "duration_s"}.
+    Raises ProfilerError when no capture is active.
+
+    stop_trace serializes the whole capture (tens of MB, seconds) — it runs
+    OUTSIDE _LOCK so a concurrent status() (served synchronously on the
+    node's event loop) never blocks behind it. The "stopping" phase keeps
+    start() refused for the whole window."""
+    import jax
+
+    with _LOCK:
+        if not _STATE["active"] or _STATE.get("stopping"):
+            raise ProfilerError("no profiler capture active")
+        run_dir, started = _STATE["dir"], _STATE["started_at"]
+        _STATE["stopping"] = True
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        # even a failed stop leaves no active session to stop again
+        with _LOCK:
+            _STATE.update(active=False, dir=None, started_at=None,
+                          stopping=False)
+    cap = {
+        "dir": run_dir,
+        "started_at": started,
+        "stopped_at": time.time(),
+        "artifacts": _artifacts(run_dir),
+    }
+    with _LOCK:
+        _STATE["last_capture"] = cap
+    _metrics_inc("stop")
+    try:
+        from tendermint_tpu.libs.trace import tracer
+
+        if tracer.enabled:
+            tracer.event(
+                "profiler.stop", dir=run_dir, artifacts=len(cap["artifacts"])
+            )
+    except Exception:
+        pass
+    return {
+        "active": False,
+        "dir": run_dir,
+        "duration_s": round(cap["stopped_at"] - started, 3) if started else None,
+        "artifacts": cap["artifacts"],
+    }
+
+
+def status() -> dict:
+    """Session snapshot — safe to call any time, never raises. Served
+    synchronously on the node's event loop, so it must stay cheap: no lock
+    held across serialization (see stop()) and no jax import/init here —
+    backend is reported only when jax is already loaded."""
+    import sys
+
+    with _LOCK:
+        st = {
+            "active": _STATE["active"],
+            "stopping": bool(_STATE.get("stopping")),
+            "dir": _STATE["dir"],
+            "started_at": _STATE["started_at"],
+            "last_capture": _STATE["last_capture"],
+        }
+    if st["active"] and st["started_at"]:
+        st["running_s"] = round(time.time() - st["started_at"], 3)
+    try:
+        jax = sys.modules.get("jax")
+        st["backend"] = jax.default_backend() if jax is not None else None
+    except Exception as e:  # profiler surface useless without jax
+        st["backend"] = None
+        st["error"] = repr(e)
+    return st
+
+
+def trace_function(fn, *args, base_dir: Optional[str] = None, **kwargs):
+    """One-flush capture: start → fn(*args) → block on the result → stop.
+    Returns (result, run_dir). The result is block_until_ready'd when it
+    supports it so the device work lands INSIDE the capture window."""
+    info = start(base_dir)
+    try:
+        out = fn(*args, **kwargs)
+        try:
+            import jax
+
+            out = jax.block_until_ready(out)
+        except Exception:
+            pass
+    finally:
+        stop()
+    _metrics_inc("trace_function")
+    return out, info["dir"]

@@ -177,6 +177,15 @@ _TOTALS: Dict[tuple, Dict[str, float]] = {}  # (backend, path) -> counters
 _LAST_FLUSH: Dict[str, Any] = {}
 _COUNTS = {"rlc_fallbacks": 0, "cache_hits": 0, "cache_misses": 0}
 _STAGE_SECONDS = {"prep": 0.0, "compile": 0.0, "transfer": 0.0, "total": 0.0}
+# Slope-methodology raw data (PERF.md: single-sync timings lie on this
+# runtime, so per-batch cost is fit from (k, seconds) over k chained
+# submits). Two sources, both served by /debug/verify_stats so a live
+# node's suspicious slope can be re-fit WITHOUT a bench rerun:
+# - the last recorded fit (bench.py rlc_slope_samples calls
+#   record_slope_samples with its raw pairs), and
+# - a bounded ring of live per-flush (n, seconds) samples for rlc* paths.
+_SLOPE_FIT: Dict[str, Any] = {}
+_FLUSH_SAMPLES: deque = deque(maxlen=128)  # (n, total_s, path)
 
 _DEVICE_LOCK = threading.Lock()
 _DEVICE: Dict[str, Any] = {
@@ -283,8 +292,30 @@ def record_flush(
         _STAGE_SECONDS["total"] += total_s
         _LAST_FLUSH.clear()
         _LAST_FLUSH.update(last)
+        if path.startswith("rlc"):
+            _FLUSH_SAMPLES.append((n, round(total_s, 6), path))
     if tracer_ is not None:
         tracer_.event("batch_verify.flush", **last)
+
+
+def record_slope_samples(
+    samples,
+    slope_ms: Optional[float] = None,
+    fused: Optional[bool] = None,
+    source: str = "bench",
+) -> None:
+    """Record a slope fit's RAW (k, seconds) pairs (bench.py
+    rlc_slope_samples) so /debug/verify_stats serves them for post-hoc
+    re-fitting — previously bench-JSON-only."""
+    with _STATS_LOCK:
+        _SLOPE_FIT.clear()
+        _SLOPE_FIT.update(
+            samples=[list(s) for s in samples],
+            slope_ms=slope_ms,
+            fused=fused,
+            source=source,
+            recorded_at=time.time(),
+        )
 
 
 def verify_stats() -> dict:
@@ -301,6 +332,10 @@ def verify_stats() -> dict:
             "stage_seconds": dict(_STAGE_SECONDS),
             "counters": dict(_COUNTS),
             "last_flush": dict(_LAST_FLUSH),
+            "slope_samples": {
+                "fit": dict(_SLOPE_FIT) or None,
+                "flush_samples": [list(s) for s in _FLUSH_SAMPLES],
+            },
         }
     out["device"] = device_health()
     try:
@@ -311,6 +346,14 @@ def verify_stats() -> dict:
         out["breaker"] = BREAKER.snapshot()
     except Exception:  # telemetry must never fail the stats read
         pass
+    try:
+        # mesh telemetry rides along so ONE stats read covers single-chip
+        # and sharded pipelines (full snapshot: GET /debug/mesh)
+        from tendermint_tpu.parallel import telemetry as _mesh_tm
+
+        out["mesh"] = _mesh_tm.mesh_stats()
+    except Exception:
+        pass
     return out
 
 
@@ -319,6 +362,8 @@ def reset_stats() -> None:
     with _STATS_LOCK:
         _TOTALS.clear()
         _LAST_FLUSH.clear()
+        _SLOPE_FIT.clear()
+        _FLUSH_SAMPLES.clear()
         for k in _COUNTS:
             _COUNTS[k] = 0
         for k in _STAGE_SECONDS:

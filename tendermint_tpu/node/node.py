@@ -111,6 +111,15 @@ class Node:
             ring_size=config.instrumentation.trace_ring_size,
         )
 
+        # stall forensics (libs/forensics.py): heartbeat the device entry
+        # points + write FORENSICS_*.json captures under [instrumentation]
+        # forensics_dir; process-global like the tracer (the env default
+        # TMTPU_FORENSICS_DIR already applied at import if set)
+        if getattr(config.instrumentation, "forensics_dir", ""):
+            from tendermint_tpu.libs import forensics as _forensics
+
+            _forensics.configure(config.instrumentation.forensics_dir)
+
         # per-height/round consensus timeline ring (consensus/timeline.py) —
         # node-local (unlike the tracer), served by /debug/consensus_timeline;
         # recording is gated on the tracer's enabled flag in cs_state

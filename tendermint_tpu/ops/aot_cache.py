@@ -145,6 +145,19 @@ def call(name: str, jit_fn, *args):
         return _call_locked(name, key, jit_fn, *args)
 
 
+def _record_aot(result: str) -> None:
+    """Artifact-cache outcome into the mesh telemetry (hit / miss /
+    corrupt): machine-scoped keys mean a foreign host's artifacts surface
+    here as misses instead of the cpu_aot_loader failures that killed
+    MULTICHIP r04/r05 — the counter is how a round proves which it was."""
+    try:
+        from tendermint_tpu.parallel import telemetry as _mesh_tm
+
+        _mesh_tm.record_aot(result)
+    except Exception:  # telemetry must never fail a kernel call
+        pass
+
+
 def _call_locked(name, key, jit_fn, *args):
     from tendermint_tpu.libs import trace as _trace
 
@@ -155,6 +168,7 @@ def _call_locked(name, key, jit_fn, *args):
         d = _cache_dir()
         path = os.path.join(d, key + ".bin") if d else None
         exp = None
+        corrupt = False
         if path and os.path.exists(path):
             try:
                 _t0 = time.perf_counter()
@@ -163,6 +177,7 @@ def _call_locked(name, key, jit_fn, *args):
                 _trace.record_compile(
                     name, time.perf_counter() - _t0, "deserialize"
                 )
+                _record_aot("hit")
             except Exception:
                 # Corrupted artifact: delete it and fall through to a fresh
                 # export — permanently disabling the AOT path for this key
@@ -177,8 +192,14 @@ def _call_locked(name, key, jit_fn, *args):
                     os.unlink(path)
                 except OSError:
                     pass
+                _record_aot("corrupt")
                 exp = None
+                corrupt = True
         if exp is None:
+            if not corrupt:
+                # hit/miss/corrupt are disjoint outcomes per call — a
+                # corrupt artifact is NOT also a miss
+                _record_aot("miss")
             _t0 = time.perf_counter()
             exp = jexport.export(jit_fn)(*args)
             # trace+lower+export wall time — the "compile" half of the

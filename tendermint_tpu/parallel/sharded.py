@@ -18,14 +18,17 @@ Two mesh shapes are supported:
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tendermint_tpu.libs import forensics as _forensics
 from tendermint_tpu.ops import cache_hardening
 from tendermint_tpu.ops.ed25519_jax import _verify_core, make_ctx, verify_prepared
+from tendermint_tpu.parallel import telemetry as _mesh_tm
 
 # Round 4 bypassed the persistent compile cache for every sharded kernel
 # (SIGSEGV on poisoned entries), which made each fresh dryrun/test process
@@ -36,15 +39,38 @@ from tendermint_tpu.ops.ed25519_jax import _verify_core, make_ctx, verify_prepar
 cache_hardening.harden()
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """Version-portable shard_map. jax >= 0.6 exposes ``jax.shard_map`` with
+    a ``check_vma`` kwarg; older releases ship it as
+    ``jax.experimental.shard_map.shard_map`` where the same knob is spelled
+    ``check_rep``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    return _legacy(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )
+
+
 def make_mesh(devices=None, shape=None, axis_names=("vals",)) -> Mesh:
-    """Build a device mesh. Default: all devices on one 'vals' axis."""
+    """Build a device mesh. Default: all devices on one 'vals' axis.
+    Also the mesh-telemetry anchor: every mesh built here lands in the
+    `mesh` block of /debug/mesh (parallel/telemetry.py)."""
     import numpy as np
 
     devices = devices if devices is not None else jax.devices()
     arr = np.asarray(devices)
     if shape is not None:
         arr = arr.reshape(shape)
-    return Mesh(arr, axis_names)
+    mesh = Mesh(arr, axis_names)
+    flat = list(arr.reshape(-1))
+    _mesh_tm.record_mesh(
+        axis_names, arr.shape, flat, getattr(flat[0], "platform", "unknown")
+    )
+    return mesh
 
 
 def _aligned(mesh: Mesh, batch_rank: int):
@@ -95,7 +121,7 @@ def sharded_verify(mesh: Mesh):
             spec_out = P(*batch_axes)
 
             @partial(
-                jax.shard_map,
+                _shard_map,
                 mesh=mesh,
                 in_specs=(spec_in, spec_in, spec_in, spec_in, spec_ctx),
                 out_specs=spec_out,
@@ -108,9 +134,29 @@ def sharded_verify(mesh: Mesh):
         return fn
 
     def run(a, r, s_bits, h_bits):
+        import numpy as np
+
         shard_batch = _shard_batch_shape(mesh, a.shape[1:])
         rank = len(a.shape) - 1
-        return _for_rank(rank)(a, r, s_bits, h_bits, make_ctx(shard_batch))
+        lanes = int(np.prod(shard_batch)) if shard_batch else 1
+        # split submit (dispatch) from finish (sync) so a wedged mesh names
+        # its phase: the heartbeat (libs/forensics.py) is readable from
+        # outside even while this thread hangs in the tunnel
+        _forensics.beat("mesh_persig_submit")
+        t0 = time.perf_counter()
+        out = _for_rank(rank)(a, r, s_bits, h_bits, make_ctx(shard_batch))
+        t1 = time.perf_counter()
+        _forensics.beat("mesh_persig_finish")
+        out = np.asarray(out)
+        _mesh_tm.record_flush(
+            "persig",
+            ndev=int(mesh.devices.size),
+            shard_lanes=lanes,
+            submit_s=t1 - t0,
+            finish_s=time.perf_counter() - t1,
+            devices=[str(d) for d in mesh.devices.flat],
+        )
+        return out
 
     return run
 
@@ -134,7 +180,7 @@ def sharded_commit_step(mesh: Mesh):
             spec_p = P(*batch_axes)
 
             @partial(
-                jax.shard_map,
+                _shard_map,
                 mesh=mesh,
                 in_specs=(spec_in, spec_in, spec_in, spec_in, spec_in, spec_ctx),
                 out_specs=(spec_p, P(), P()),
@@ -164,14 +210,28 @@ def sharded_commit_step(mesh: Mesh):
 
         shard_batch = _shard_batch_shape(mesh, a.shape[1:])
         rank = len(a.shape) - 1
+        lanes = int(np.prod(shard_batch)) if shard_batch else 1
+        _forensics.beat("mesh_commit_submit")
+        t0 = time.perf_counter()
         mask, talled, total = _for_rank(rank)(
             a, r, s_bits, h_bits, power_planes, make_ctx(shard_batch)
         )
+        t1 = time.perf_counter()
 
         def _join(planes) -> int:
             return sum(int(v) << (16 * k) for k, v in enumerate(np.asarray(planes)))
 
+        _forensics.beat("mesh_commit_finish")
         ok = _join(talled) * 3 > _join(total) * 2
+        _mesh_tm.record_flush(
+            "commit_step",
+            ndev=int(mesh.devices.size),
+            shard_lanes=lanes,
+            submit_s=t1 - t0,
+            finish_s=time.perf_counter() - t1,
+            devices=[str(d) for d in mesh.devices.flat],
+            ok=bool(ok),
+        )
         return mask, ok
 
     return step
@@ -224,7 +284,7 @@ def sharded_rlc_check(mesh: Mesh):
             spec_fctx = jax.tree.map(lambda _: P(), fctx)
 
             @partial(
-                jax.shard_map,
+                _shard_map,
                 mesh=mesh,
                 in_specs=(P(axis), P(axis), P(axis), spec_fctx, spec_ctx_small),
                 out_specs=(P(), P(axis)),
@@ -260,10 +320,29 @@ def sharded_rlc_check(mesh: Mesh):
         return fn
 
     def run(pts_bytes, perm, ends):
+        import numpy as np
+
         if pts_bytes.shape[0] != ndev:
             raise ValueError(f"leading axis {pts_bytes.shape[0]} != mesh size {ndev}")
         n_sh = pts_bytes.shape[2]
+        _forensics.beat("mesh_rlc_submit")
+        t0 = time.perf_counter()
         bok, ok = _for_lanes(n_sh)(pts_bytes, perm, ends)
+        t1 = time.perf_counter()
+        _forensics.beat("mesh_rlc_finish")
+        bok = np.asarray(bok)
+        ok = np.asarray(ok)
+        _mesh_tm.record_flush(
+            "rlc",
+            ndev=ndev,
+            shard_lanes=n_sh,
+            submit_s=t1 - t0,
+            finish_s=time.perf_counter() - t1,
+            # ONE all_gather of the (4, 20) int32 partial point per device
+            all_gather_bytes=ndev * 4 * 20 * 4,
+            devices=[str(d) for d in mesh.devices.flat],
+            ok=bool(bok),
+        )
         return bok, ok.reshape(-1)
 
     return run
@@ -282,6 +361,7 @@ def prepare_rlc_shards(pts_bytes, scalars, ndev: int):
     if n % ndev:
         raise ValueError(f"lanes {n} not divisible by mesh size {ndev}")
     per = n // ndev
+    t0 = time.perf_counter()
     digits = scalars_to_bytes(scalars, n)
     pts, perms, nodes = [], [], []
     for d in range(ndev):
@@ -290,7 +370,9 @@ def prepare_rlc_shards(pts_bytes, scalars, ndev: int):
         pts.append(np.ascontiguousarray(pts_bytes[sl].T))
         perms.append(perm)
         nodes.append(ends)
-    return np.stack(pts), np.stack(perms), np.stack(nodes)
+    out = np.stack(pts), np.stack(perms), np.stack(nodes)
+    _mesh_tm.record_prepare(ndev, per, time.perf_counter() - t0)
+    return out
 
 
 def split_powers(powers) -> "jnp.ndarray":

@@ -128,6 +128,10 @@ class RPCServer:
             "/debug/consensus_timeline", self._handle_debug_consensus_timeline
         )
         self.app.router.add_get("/debug/overload", self._handle_debug_overload)
+        self.app.router.add_get("/debug/mesh", self._handle_debug_mesh)
+        self.app.router.add_get(
+            "/debug/device_profile", self._handle_debug_device_profile
+        )
         self.app.router.add_get("/{method}", self._handle_uri)
         self.runner: Optional[web.AppRunner] = None
         # load-shedding gate ([rpc] max_inflight_requests); the overload
@@ -173,6 +177,8 @@ class RPCServer:
             "debug_verify_stats": self._debug_verify_stats,
             "consensus_timeline": self._consensus_timeline,
             "debug_overload": self._debug_overload,
+            "debug_mesh": self._debug_mesh,
+            "debug_device_profile": self._debug_device_profile,
         }
 
     # -- load shedding -------------------------------------------------------
@@ -284,6 +290,21 @@ class RPCServer:
     async def _handle_debug_overload(self, request: web.Request) -> web.Response:
         try:
             return web.json_response(_result(None, await self._debug_overload({})))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
+
+    async def _handle_debug_mesh(self, request: web.Request) -> web.Response:
+        try:
+            return web.json_response(_result(None, await self._debug_mesh({})))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
+
+    async def _handle_debug_device_profile(self, request: web.Request) -> web.Response:
+        params = {k: v for k, v in request.query.items()}
+        try:
+            return web.json_response(
+                _result(None, await self._debug_device_profile(params))
+            )
         except Exception as e:
             return web.json_response(_error(None, -32603, "internal error", str(e)))
 
@@ -981,6 +1002,49 @@ class RPCServer:
                 },
             }
         return out
+
+    async def _debug_mesh(self, params) -> dict:
+        """Multi-chip mesh telemetry snapshot (parallel/telemetry.py): the
+        active mesh, per-shard lane layout, pad waste, submit/finish wall
+        totals, all_gather traffic, and AOT artifact-cache outcomes — the
+        page a MULTICHIP round's post-mortem starts from. Read-only, served
+        regardless of rpc.unsafe (like /debug/verify_stats); on a
+        single-device node it reports mesh: null with zeroed totals."""
+        from tendermint_tpu.parallel import telemetry as mesh_tm
+
+        return mesh_tm.mesh_stats()
+
+    async def _debug_device_profile(self, params) -> dict:
+        """On-demand device profiler capture (libs/profiler.py over
+        jax.profiler): ?action=start begins a capture into a fresh run dir
+        under [instrumentation] profile_dir, ?action=stop ends it and lists
+        the artifacts (analyze offline with tools/profile_report.py),
+        ?action=status (default) reports the session. One capture per
+        process; start while active is an error, not a restart."""
+        from tendermint_tpu.libs import profiler
+
+        action = params.get("action", "status")
+        loop = asyncio.get_running_loop()
+        if action == "start":
+            # start/stop mutate process-global profiler state and write tens
+            # of MB per capture — unsafe-gated like every mutating route;
+            # status stays open (read-only, like /debug/mesh)
+            self._require_unsafe()
+            base = (
+                getattr(self.node.config.instrumentation, "profile_dir", "")
+                or profiler.default_base_dir()
+            )
+            return await loop.run_in_executor(None, profiler.start, base)
+        if action == "stop":
+            self._require_unsafe()
+            # stop_trace serializes the whole capture (tens of MB, seconds) —
+            # off the event loop so consensus keeps stepping while it writes
+            return await loop.run_in_executor(None, profiler.stop)
+        if action == "status":
+            return profiler.status()
+        raise ValueError(
+            f"unknown action {action!r} (want start|stop|status)"
+        )
 
     async def _dial_peers(self, params) -> dict:
         """unsafe route (reference: rpc/core/net.go UnsafeDialPeers)."""

@@ -1,0 +1,371 @@
+"""Perf-trajectory ledger: every round's datapoint in one table, forever.
+
+Aggregates the driver's per-round artifacts — `BENCH_r*.json` (wrapper:
+`{"n", "cmd", "rc", "tail", "parsed": <bench JSON line or null>}`) and
+`MULTICHIP_r*.json` (`{"n_devices", "rc", "ok", "skipped", "tail"}`) — into
+one trajectory table rendered as markdown + JSON:
+
+- headline metric/value/speedup per round, with **lost** datapoints flagged
+  and diagnosed (r01: no parseable JSON; r05: `value: -1` device-init
+  stall) instead of silently skipped;
+- per-scenario speedups so a regression names its scenario;
+- the machine fingerprint + jax versions each round ran on (stamped by
+  bench.py since round 7; older rounds show `—`), because the r04→r05 AOT
+  failures were cross-host artifacts that BENCH json couldn't expose;
+- multichip round diagnoses (rc-124 timeout, cpu_aot_loader
+  machine-feature mismatch, skip, ok).
+
+`--check` turns the ledger into a budget guard in the spirit of
+tests/test_hotpath_guard.py: exit nonzero when the newest non-lost,
+non-degraded headline regressed by more than `--tolerance` (default 25%)
+against the best earlier round of the same metric — so a perf regression
+fails loudly at ledger time, not three rounds later in someone's memory.
+
+    python tools/perf_ledger.py [--root DIR] [--json OUT] [--markdown OUT]
+                                [--check] [--tolerance 0.25]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def _round_of(path: str) -> Optional[int]:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _round_label(row: dict) -> str:
+    """`r04` — or the filename stem for artifacts with no numeric round
+    suffix (BENCH_rerun.json matches the glob but not _ROUND_RE); the
+    ledger flags odd artifacts, it never dies on them."""
+    if row.get("round") is not None:
+        return f"r{row['round']:02d}"
+    return os.path.splitext(row.get("file") or "?")[0]
+
+
+def _load(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        return {"_load_error": f"{type(e).__name__}: {e}"}
+
+
+def _scenario_speedups(extra: dict) -> Dict[str, Any]:
+    """Per-scenario comparable numbers out of a bench `extra` blob."""
+    out: Dict[str, Any] = {}
+    for name, res in (extra or {}).items():
+        if not isinstance(res, dict):
+            continue
+        entry: Dict[str, Any] = {}
+        for key in ("speedup_e2e", "speedup"):
+            if isinstance(res.get(key), (int, float)):
+                entry["speedup"] = res[key]
+                break
+        if isinstance(res.get("tpu_e2e_ms"), (int, float)):
+            entry["tpu_e2e_ms"] = res["tpu_e2e_ms"]
+        if isinstance(res.get("sigs_per_sec"), (int, float)):
+            entry["sigs_per_sec"] = res["sigs_per_sec"]
+        if res.get("degraded"):
+            entry["degraded"] = res["degraded"]
+        if res.get("skipped"):
+            entry["skipped"] = True
+        if entry:
+            out[name] = entry
+    return out
+
+
+def parse_bench(path: str) -> dict:
+    """One BENCH_r*.json → a ledger row. Accepts both the driver wrapper
+    shape and a bare bench JSON line saved to a file."""
+    doc = _load(path)
+    row: Dict[str, Any] = {
+        "round": _round_of(path),
+        "file": os.path.basename(path),
+        "kind": "bench",
+        "lost": False,
+        "lost_reason": None,
+        "degraded": None,
+        "metric": None,
+        "value": None,
+        "unit": None,
+        "vs_baseline": None,
+        "fingerprint": None,
+        "versions": None,
+        "scenarios": {},
+    }
+    if doc is None or "_load_error" in (doc or {}):
+        row["lost"] = True
+        row["lost_reason"] = (doc or {}).get("_load_error", "unreadable file")
+        return row
+    parsed = doc.get("parsed", doc if "metric" in doc else None)
+    row["rc"] = doc.get("rc")
+    if parsed is None:
+        row["lost"] = True
+        row["lost_reason"] = (
+            f"no parseable bench JSON (rc={doc.get('rc')})"
+            if "rc" in doc
+            else "no parseable bench JSON"
+        )
+        return row
+    row["metric"] = parsed.get("metric")
+    row["value"] = parsed.get("value")
+    row["unit"] = parsed.get("unit")
+    row["vs_baseline"] = parsed.get("vs_baseline")
+    row["degraded"] = parsed.get("degraded")
+    extra = parsed.get("extra") or {}
+    host = extra.get("host") or parsed.get("host") or {}
+    if host:
+        row["fingerprint"] = host.get("machine_fingerprint")
+        row["versions"] = {
+            k: host.get(k) for k in ("jax", "jaxlib", "python", "git_sha")
+            if host.get(k)
+        }
+    row["scenarios"] = _scenario_speedups(extra)
+    if not isinstance(row["value"], (int, float)) or row["value"] < 0:
+        row["lost"] = True
+        err = extra.get("error") or parsed.get("degrade_reason")
+        row["lost_reason"] = (
+            f"value {row['value']!r}" + (f" ({err})" if err else "")
+        )
+    elif doc.get("rc") not in (0, None):
+        # the datapoint parsed but the run exited nonzero — keep the value,
+        # flag the round
+        row["lost_reason"] = f"bench exited rc={doc['rc']} (value salvaged)"
+    return row
+
+
+def diagnose_multichip(doc: dict) -> str:
+    if doc.get("skipped"):
+        return "skipped"
+    tail = doc.get("tail") or ""
+    if doc.get("rc") == 124:
+        return "timeout (rc 124): hard deadline with no diagnosis — "\
+               "the forensics watchdog (libs/forensics.py) now captures these"
+    if "cpu_aot_loader" in tail or "machine feature" in tail.lower():
+        return "AOT machine-feature mismatch (foreign-host artifact loaded; "\
+               "fixed by machine-fingerprint cache scoping)"
+    if doc.get("ok"):
+        return "ok"
+    if doc.get("rc", 0) != 0:
+        return f"failed rc={doc.get('rc')}"
+    return "failed (no diagnosis in tail)"
+
+
+def parse_multichip(path: str) -> dict:
+    doc = _load(path)
+    row: Dict[str, Any] = {
+        "round": _round_of(path),
+        "file": os.path.basename(path),
+        "kind": "multichip",
+    }
+    if doc is None or "_load_error" in (doc or {}):
+        row.update(ok=False, lost=True,
+                   diagnosis=(doc or {}).get("_load_error", "unreadable file"))
+        return row
+    row.update(
+        n_devices=doc.get("n_devices"),
+        rc=doc.get("rc"),
+        ok=bool(doc.get("ok")),
+        skipped=bool(doc.get("skipped")),
+        lost=not doc.get("ok") and not doc.get("skipped"),
+        diagnosis=diagnose_multichip(doc),
+    )
+    return row
+
+
+def load_ledger(root: str) -> dict:
+    bench = sorted(
+        (parse_bench(p) for p in glob.glob(os.path.join(root, "BENCH_r*.json"))),
+        key=lambda r: (r["round"] is None, r["round"] or 0, r.get("file") or ""),
+    )
+    multichip = sorted(
+        (parse_multichip(p) for p in glob.glob(os.path.join(root, "MULTICHIP_r*.json"))),
+        key=lambda r: (r["round"] is None, r["round"] or 0, r.get("file") or ""),
+    )
+    return {
+        "root": os.path.abspath(root),
+        "bench": bench,
+        "multichip": multichip,
+        "lost_datapoints": [
+            r["file"] for r in bench + multichip if r.get("lost")
+        ],
+    }
+
+
+def check_regressions(ledger: dict, tolerance: float = 0.25) -> List[str]:
+    """Headline budget guard: the newest healthy bench round must not be
+    slower than the best earlier healthy round of the SAME metric by more
+    than `tolerance`. Returns human-readable failures (empty = pass)."""
+    healthy = [
+        r for r in ledger["bench"]
+        if not r["lost"] and not r.get("degraded")
+        and isinstance(r.get("value"), (int, float))
+    ]
+    if len(healthy) < 2:
+        return []
+    latest = healthy[-1]
+    prior = [r for r in healthy[:-1] if r["metric"] == latest["metric"]]
+    failures = []
+    if prior:
+        best = min(prior, key=lambda r: r["value"])
+        budget = best["value"] * (1.0 + tolerance)
+        if latest["value"] > budget:
+            failures.append(
+                f"headline regression: {latest['metric']} = "
+                f"{latest['value']:.3f}{latest['unit'] or ''} in "
+                f"{latest['file']} vs best {best['value']:.3f} in "
+                f"{best['file']} (budget {budget:.3f}, tolerance "
+                f"{tolerance:.0%})"
+            )
+    return failures
+
+
+def _fmt_versions(v: Optional[dict]) -> str:
+    if not v:
+        return "—"
+    bits = []
+    if v.get("jax"):
+        bits.append(f"jax {v['jax']}")
+    if v.get("git_sha"):
+        bits.append(v["git_sha"][:9])
+    return ", ".join(bits) or "—"
+
+
+def render_markdown(ledger: dict) -> str:
+    lines = [
+        "# Perf trajectory ledger",
+        "",
+        f"Source: `{ledger['root']}` — {len(ledger['bench'])} bench rounds, "
+        f"{len(ledger['multichip'])} multichip rounds, "
+        f"{len(ledger['lost_datapoints'])} lost/failed datapoints.",
+        "",
+        "## Bench rounds",
+        "",
+        "| round | metric | value | speedup | host | status |",
+        "|---:|---|---:|---:|---|---|",
+    ]
+    for r in ledger["bench"]:
+        if r["lost"]:
+            status = f"**LOST** — {r['lost_reason']}"
+            value = "—"
+            speed = "—"
+        else:
+            status = "degraded (cpu-fallback)" if r.get("degraded") else "ok"
+            if r.get("lost_reason"):
+                status += f"; {r['lost_reason']}"
+            value = (
+                f"{r['value']:.1f} {r['unit'] or ''}".strip()
+                if isinstance(r["value"], (int, float))
+                else "—"
+            )
+            speed = (
+                f"{r['vs_baseline']:.2f}×"
+                if isinstance(r["vs_baseline"], (int, float)) and r["vs_baseline"]
+                else "—"
+            )
+        host = r["fingerprint"] or "—"
+        if r.get("versions"):
+            host += f" ({_fmt_versions(r['versions'])})"
+        lines.append(
+            f"| {_round_label(r)} | {r['metric'] or '—'} | {value} "
+            f"| {speed} | {host} | {status} |"
+        )
+    lines += ["", "### Per-scenario speedups", ""]
+    scen_names: List[str] = []
+    for r in ledger["bench"]:
+        for name in r["scenarios"]:
+            if name not in scen_names:
+                scen_names.append(name)
+    if scen_names:
+        lines.append("| scenario | " + " | ".join(
+            _round_label(r) for r in ledger["bench"]) + " |")
+        lines.append("|---|" + "---:|" * len(ledger["bench"]))
+        for name in scen_names:
+            cells = []
+            for r in ledger["bench"]:
+                s = r["scenarios"].get(name)
+                if not s:
+                    cells.append("—")
+                elif s.get("degraded"):
+                    cells.append("cpu!")
+                elif "speedup" in s:
+                    cells.append(f"{s['speedup']:.2f}×")
+                elif "sigs_per_sec" in s:
+                    cells.append(f"{s['sigs_per_sec']:,}/s")
+                else:
+                    cells.append("·")
+            lines.append(f"| {name} | " + " | ".join(cells) + " |")
+    else:
+        lines.append("(no per-scenario data)")
+    lines += [
+        "",
+        "## Multichip rounds",
+        "",
+        "| round | devices | rc | status |",
+        "|---:|---:|---:|---|",
+    ]
+    for r in ledger["multichip"]:
+        lines.append(
+            f"| {_round_label(r)} | {r.get('n_devices', '—')} "
+            f"| {r.get('rc', '—')} | {r.get('diagnosis', '—')} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "--root", default=".",
+        help="directory holding BENCH_r*.json / MULTICHIP_r*.json (repo root)",
+    )
+    ap.add_argument("--json", help="write the ledger as JSON here")
+    ap.add_argument("--markdown", help="write the markdown table here")
+    ap.add_argument(
+        "--check", action="store_true",
+        help="exit 2 on a headline budget regression (see --tolerance)",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=0.25,
+        help="allowed headline slowdown vs the best prior round (default 0.25)",
+    )
+    args = ap.parse_args(argv)
+    ledger = load_ledger(args.root)
+    if not ledger["bench"] and not ledger["multichip"]:
+        print(f"error: no BENCH_r*/MULTICHIP_r* files under {args.root!r}",
+              file=sys.stderr)
+        return 1
+    failures = check_regressions(ledger, args.tolerance)
+    ledger["regressions"] = failures
+    md = render_markdown(ledger)
+    if failures:
+        md += "\n## REGRESSIONS\n\n" + "\n".join(f"- {f}" for f in failures) + "\n"
+    sys.stdout.write(md)
+    if args.markdown:
+        with open(args.markdown, "w") as f:
+            f.write(md)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(ledger, f, indent=1)
+    if args.check and failures:
+        for f in failures:
+            print(f"REGRESSION: {f}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,416 @@
+"""Offline profiler-trace analyzer: capture directory → per-stage time table.
+
+Turns a `libs/profiler.py` capture (or any jax/TensorBoard profile dump)
+into the PERF.md-style attribution table — per-kernel and per-fused-stage
+(uptree, fenwick_reduce, bucket_fold, persig) totals — in one command
+instead of an afternoon of perfetto spelunking:
+
+    python tools/profile_report.py <capture-dir-or-file> [--top N] [--json OUT]
+
+Two input forms, no external deps:
+
+- `*.trace.json.gz` — the perfetto/chrome trace jax writes next to the
+  xplane file: `X` (complete) events with per-thread nesting; process and
+  thread names from `M` metadata events.
+- `*.xplane.pb` — the XSpace protobuf, parsed with a minimal protobuf
+  wire-format walker (tensorflow/tensorboard are NOT importable in this
+  container, and the schema needed here is 4 small messages: XSpace →
+  XPlane → XLine → XEvent + the id→name metadata maps).
+
+Times are reported as **total** (event wall span, includes children) and
+**self** (total minus nested children on the same thread) — `self` is the
+honest per-stage cost; `total` localises where a wall-clock budget went.
+Python host-tracing events (`$`-prefixed) are folded into one `host_python`
+stage so device/runtime rows aren't swamped.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Stage classification, first match wins (case-insensitive). Kernel names
+# surface differently per backend (Pjit wrappers on host, fusion names on
+# device planes), so patterns match the stable substrings our kernels carry
+# (ops/pallas_msm.py, ops/msm_jax.py, ops/ed25519_jax.py).
+STAGE_PATTERNS: List[Tuple[str, str]] = [
+    ("uptree", r"uptree"),
+    ("fenwick_reduce", r"fenwick"),
+    ("bucket_fold", r"bucket"),
+    ("persig", r"persig|verify_prepared|verify_core|ladder"),
+    ("decompress", r"decompress|ristretto"),
+    ("msm_other", r"rlc|msm|pallas|pippenger"),
+    (
+        "compile",
+        r"backend_compile|compile|codegen|llvm|hlo passes|lower|"
+        r"trace_to_jaxpr|optimization|emit",
+    ),
+    (
+        "transfer",
+        r"transferto|transferfrom|device_put|copyto|bufferfromhost|"
+        r"toliteral|h2d|d2h|copy_to|transfer",
+    ),
+    (
+        "dispatch",
+        r"pjitfunction|executesharded|execute|runthunks|thunk|"
+        r"parsearguments|donate",
+    ),
+    ("host_python", r"^\$"),
+]
+_COMPILED = [(stage, re.compile(pat, re.IGNORECASE)) for stage, pat in STAGE_PATTERNS]
+
+
+def classify(name: str) -> str:
+    for stage, rx in _COMPILED:
+        if rx.search(name):
+            return stage
+    return "other"
+
+
+# ---------------------------------------------------------------------------
+# Input discovery
+
+
+def find_capture_files(path: str) -> List[str]:
+    """Resolve a run dir / capture dir / single file to trace artifacts,
+    preferring the newest capture and the richer json form."""
+    if os.path.isfile(path):
+        return [path]
+    jsons = sorted(glob.glob(os.path.join(path, "**", "*.trace.json.gz"), recursive=True))
+    xplanes = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"), recursive=True))
+    picked = []
+    if jsons:
+        picked.append(jsons[-1])
+    elif xplanes:
+        picked.append(xplanes[-1])
+    return picked
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace (.trace.json.gz) parsing
+
+
+def _load_chrome_trace(path: str):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    evs = data.get("traceEvents", data if isinstance(data, list) else [])
+    pnames: Dict[int, str] = {}
+    tnames: Dict[Tuple[int, int], str] = {}
+    out = []
+    for e in evs:
+        ph = e.get("ph")
+        if ph == "M":
+            if e.get("name") == "process_name":
+                pnames[e.get("pid")] = e.get("args", {}).get("name", "")
+            elif e.get("name") == "thread_name":
+                tnames[(e.get("pid"), e.get("tid"))] = e.get("args", {}).get("name", "")
+        elif ph == "X":
+            out.append(
+                {
+                    "name": e.get("name", ""),
+                    "ts_us": float(e.get("ts", 0.0)),
+                    "dur_us": float(e.get("dur", 0.0)),
+                    "pid": e.get("pid"),
+                    "tid": e.get("tid"),
+                }
+            )
+    for e in out:
+        e["plane"] = pnames.get(e["pid"], str(e["pid"]))
+        e["thread"] = tnames.get((e["pid"], e["tid"]), str(e["tid"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# xplane (.xplane.pb) parsing — minimal protobuf wire walker
+
+
+def _walk(buf: bytes, pos: int = 0, end: Optional[int] = None):
+    """Yield (field_no, wire_type, value) triples from a protobuf buffer.
+    Varints decode to int; length-delimited fields yield memoryview slices."""
+    view = memoryview(buf)
+    if end is None:
+        end = len(buf)
+    while pos < end:
+        tag = 0
+        shift = 0
+        while True:
+            b = view[pos]
+            pos += 1
+            tag |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        fno, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v = 0
+            shift = 0
+            while True:
+                b = view[pos]
+                pos += 1
+                v |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            yield fno, wt, v
+        elif wt == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = view[pos]
+                pos += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            yield fno, wt, view[pos : pos + ln]
+            pos += ln
+        elif wt == 5:
+            yield fno, wt, view[pos : pos + 4]
+            pos += 4
+        elif wt == 1:
+            yield fno, wt, view[pos : pos + 8]
+            pos += 8
+        else:  # groups (3/4) never appear in xplane
+            raise ValueError(f"unsupported wire type {wt} at {pos}")
+
+
+def _svarint(v: int) -> int:
+    """Protobuf int64 fields arrive as two's-complement varints."""
+    return v - (1 << 64) if v >= 1 << 63 else v
+
+
+def _load_xplane(path: str):
+    """XSpace → flat event list. Schema (xplane.proto): XSpace.planes=1;
+    XPlane{name=2, lines=3, event_metadata=4 map<i64,XEventMetadata{name=2}>};
+    XLine{name=2, timestamp_ns=3, events=4, display_name=11};
+    XEvent{metadata_id=1, offset_ps=2, duration_ps=3}."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    out = []
+    for fno, _wt, plane_buf in _walk(buf):
+        if fno != 1:
+            continue
+        plane_name = ""
+        lines = []
+        ev_names: Dict[int, str] = {}
+        for pf, _pwt, pv in _walk(plane_buf):
+            if pf == 2:
+                plane_name = bytes(pv).decode(errors="replace")
+            elif pf == 3:
+                lines.append(pv)
+            elif pf == 4:  # map entry {key=1 varint, value=2 XEventMetadata}
+                key, name = None, ""
+                for mf, _mwt, mv in _walk(pv):
+                    if mf == 1:
+                        key = _svarint(mv)
+                    elif mf == 2:
+                        for ef, _ewt, ev in _walk(mv):
+                            if ef == 2:
+                                name = bytes(ev).decode(errors="replace")
+                if key is not None:
+                    ev_names[key] = name
+        for line_buf in lines:
+            line_name = ""
+            line_ts_ns = 0
+            events = []
+            for lf, _lwt, lv in _walk(line_buf):
+                if lf == 2:
+                    line_name = bytes(lv).decode(errors="replace")
+                elif lf == 11 and not line_name:
+                    line_name = bytes(lv).decode(errors="replace")
+                elif lf == 3:
+                    line_ts_ns = _svarint(lv)
+                elif lf == 4:
+                    events.append(lv)
+            for ev_buf in events:
+                mid = offset_ps = dur_ps = 0
+                for ef, _ewt, ev in _walk(ev_buf):
+                    if ef == 1:
+                        mid = _svarint(ev)
+                    elif ef == 2:
+                        offset_ps = _svarint(ev)
+                    elif ef == 3:
+                        dur_ps = _svarint(ev)
+                out.append(
+                    {
+                        "name": ev_names.get(mid, f"metadata:{mid}"),
+                        "ts_us": line_ts_ns / 1e3 + offset_ps / 1e6,
+                        "dur_us": dur_ps / 1e6,
+                        "pid": plane_name,
+                        "tid": line_name,
+                        "plane": plane_name,
+                        "thread": line_name,
+                    }
+                )
+    return out
+
+
+def load_events(path: str) -> List[dict]:
+    if path.endswith(".xplane.pb"):
+        return _load_xplane(path)
+    return _load_chrome_trace(path)
+
+
+# ---------------------------------------------------------------------------
+# Aggregation
+
+
+def _with_self_times(events: List[dict]) -> None:
+    """Annotate each event with `self_us` = dur minus same-thread nested
+    children (stack sweep per thread; chrome/xplane events nest properly)."""
+    by_thread: Dict[Tuple, List[dict]] = {}
+    for e in events:
+        e["self_us"] = e["dur_us"]
+        by_thread.setdefault((e["pid"], e["tid"]), []).append(e)
+    for evs in by_thread.values():
+        evs.sort(key=lambda e: (e["ts_us"], -e["dur_us"]))
+        stack: List[dict] = []
+        for e in evs:
+            while stack and stack[-1]["ts_us"] + stack[-1]["dur_us"] <= e["ts_us"] + 1e-9:
+                stack.pop()
+            if stack:
+                stack[-1]["self_us"] -= e["dur_us"]
+            stack.append(e)
+
+
+_PROFILER_SELF = re.compile(r"(start|stop)_trace$")
+
+
+def analyze(events: List[dict]) -> dict:
+    """Events → {wall_ms, stages: [...], ops: [...], planes: [...]} with
+    stages/ops sorted by self time descending. The profiler's own
+    start/stop_trace wrapper events span the whole capture window and would
+    swamp the host_python stage, so they are dropped first."""
+    events = [e for e in events if not _PROFILER_SELF.search(e["name"])]
+    _with_self_times(events)
+    ops: Dict[str, dict] = {}
+    stages: Dict[str, dict] = {}
+    planes: Dict[str, dict] = {}
+    t_min, t_max = float("inf"), 0.0
+    for e in events:
+        t_min = min(t_min, e["ts_us"])
+        t_max = max(t_max, e["ts_us"] + e["dur_us"])
+        stage = classify(e["name"])
+        o = ops.setdefault(
+            e["name"], {"stage": stage, "count": 0, "total_us": 0.0, "self_us": 0.0}
+        )
+        o["count"] += 1
+        o["total_us"] += e["dur_us"]
+        o["self_us"] += max(0.0, e["self_us"])
+        s = stages.setdefault(stage, {"count": 0, "total_us": 0.0, "self_us": 0.0})
+        s["count"] += 1
+        s["total_us"] += e["dur_us"]
+        s["self_us"] += max(0.0, e["self_us"])
+        p = planes.setdefault(e["plane"], {"events": 0, "self_us": 0.0})
+        p["events"] += 1
+        p["self_us"] += max(0.0, e["self_us"])
+    wall_us = (t_max - t_min) if events else 0.0
+    self_total = sum(s["self_us"] for s in stages.values()) or 1.0
+
+    def _row(name, d):
+        return {
+            "name": name,
+            **{k: (round(v, 3) if isinstance(v, float) else v) for k, v in d.items()},
+            "share": round(d["self_us"] / self_total, 4),
+        }
+
+    return {
+        "events": len(events),
+        "wall_ms": round(wall_us / 1e3, 3),
+        "stages": sorted(
+            (_row(k, v) for k, v in stages.items()),
+            key=lambda r: -r["self_us"],
+        ),
+        "ops": sorted(
+            (_row(k, v) for k, v in ops.items()), key=lambda r: -r["self_us"]
+        ),
+        "planes": [
+            {"plane": k, **{kk: round(vv, 3) for kk, vv in v.items()}}
+            for k, v in sorted(planes.items())
+        ],
+    }
+
+
+def report(path: str, top: int = 25) -> dict:
+    """Full report for a capture dir or trace file."""
+    files = find_capture_files(path)
+    if not files:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz or *.xplane.pb under {path!r}"
+        )
+    events = []
+    for f in files:
+        events.extend(load_events(f))
+    out = analyze(events)
+    out["capture"] = files
+    out["ops"] = out["ops"][: max(0, top)]
+    return out
+
+
+def render_markdown(rep: dict) -> str:
+    lines = [
+        f"# Profile report — {len(rep.get('capture', []))} artifact(s), "
+        f"{rep['events']} events, {rep['wall_ms']:.1f} ms wall",
+        "",
+        "## Per-stage (self time; total includes nested children)",
+        "",
+        "| stage | events | self ms | total ms | share |",
+        "|---|---:|---:|---:|---:|",
+    ]
+    for s in rep["stages"]:
+        lines.append(
+            f"| {s['name']} | {s['count']} | {s['self_us']/1e3:.3f} "
+            f"| {s['total_us']/1e3:.3f} | {s['share']*100:.1f}% |"
+        )
+    lines += [
+        "",
+        "## Top ops",
+        "",
+        "| op | stage | count | self ms | total ms |",
+        "|---|---|---:|---:|---:|",
+    ]
+    for o in rep["ops"]:
+        name = o["name"] if len(o["name"]) <= 72 else o["name"][:69] + "..."
+        lines.append(
+            f"| `{name}` | {o['stage']} | {o['count']} "
+            f"| {o['self_us']/1e3:.3f} | {o['total_us']/1e3:.3f} |"
+        )
+    if rep.get("planes"):
+        lines += ["", "## Planes", ""]
+        for p in rep["planes"]:
+            lines.append(
+                f"- `{p['plane']}`: {p['events']} events, "
+                f"{p['self_us']/1e3:.1f} ms self"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="capture directory (or a single trace file)")
+    ap.add_argument("--top", type=int, default=25, help="top-N ops to list")
+    ap.add_argument("--json", help="also write the full report as JSON here")
+    args = ap.parse_args(argv)
+    try:
+        rep = report(args.path, top=args.top)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    sys.stdout.write(render_markdown(rep))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rep, f, indent=1)
+        print(f"\nJSON report: {args.json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

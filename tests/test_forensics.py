@@ -1,0 +1,224 @@
+"""Stall forensics (libs/forensics.py): heartbeat ring write/read, watchdog
+capture with a deliberately hung child process, and the chaos-hang
+integration at the crypto/batch device entry points — the pipeline that
+turns the next MULTICHIP rc-124 into a diagnosis instead of a bare -1."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.libs import forensics as F
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _unconfigured_after():
+    yield
+    F.configure(None)
+
+
+def test_heartbeat_write_read_roundtrip(tmp_path):
+    hb = F.Heartbeat(str(tmp_path / "hb.bin"), slots=8)
+    for i in range(3):
+        hb.beat(f"phase{i}")
+    beats = F.Heartbeat.read(hb.path)
+    assert [b["phase"] for b in beats] == ["phase0", "phase1", "phase2"]
+    assert [b["seq"] for b in beats] == [1, 2, 3]
+    assert all(b["pid"] == os.getpid() for b in beats)
+    assert all(b["age_s"] < 60 for b in beats)
+
+
+def test_heartbeat_ring_wraps_keeping_newest(tmp_path):
+    hb = F.Heartbeat(str(tmp_path / "hb.bin"), slots=4)
+    for i in range(10):
+        hb.beat(f"p{i}")
+    beats = F.Heartbeat.read(hb.path)
+    assert [b["phase"] for b in beats] == ["p6", "p7", "p8", "p9"]
+    assert F.Heartbeat.read(hb.path, limit=2)[-1]["phase"] == "p9"
+
+
+def test_heartbeat_sequence_survives_reopen(tmp_path):
+    """A restarted process continues the sequence instead of erasing the
+    pre-crash tail an investigator may still want."""
+    p = str(tmp_path / "hb.bin")
+    F.Heartbeat(p, slots=8).beat("before-crash")
+    F.Heartbeat(p, slots=8).beat("after-restart")
+    assert [b["phase"] for b in F.Heartbeat.read(p)] == [
+        "before-crash", "after-restart"
+    ]
+
+
+def test_heartbeat_read_rejects_foreign_file(tmp_path):
+    p = tmp_path / "not_hb.bin"
+    p.write_bytes(b"definitely not a heartbeat ring" * 4)
+    with pytest.raises(ValueError):
+        F.Heartbeat.read(str(p))
+
+
+def test_module_beat_is_noop_until_configured(tmp_path):
+    F.configure(None)
+    assert not F.enabled() and F.heartbeat_path() is None
+    F.beat("anything")  # must not raise
+    path = F.configure(str(tmp_path))
+    assert F.enabled() and path == F.heartbeat_path()
+    F.beat("rlc_submit")
+    assert F.Heartbeat.read(path)[-1]["phase"] == "rlc_submit"
+
+
+def test_capture_names_wedged_phase(tmp_path):
+    F.configure(str(tmp_path))
+    F.beat("rlc_submit")
+    F.beat("rlc_finish")
+    path = F.capture("unit test", kind="manual", probe_devices=False)
+    assert os.path.basename(path).startswith("FORENSICS_")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["wedged_phase"] == "rlc_finish"  # the newest heartbeat
+    assert doc["kind"] == "manual" and doc["reason"] == "unit test"
+    assert doc["heartbeat"][-1]["phase"] == "rlc_finish"
+    assert "thread" in doc["threads"].lower()  # faulthandler stack dump
+    assert doc["breaker"]  # snapshot (or an error string — never absent)
+    assert doc["jax"] == {"skipped": True}
+    assert path in F.find_captures(str(tmp_path))
+    assert F.find_captures(str(tmp_path), since_ts=time.time() + 60) == []
+
+
+def test_two_captures_same_second_do_not_collide(tmp_path):
+    F.configure(str(tmp_path))
+    p1 = F.capture("first", probe_devices=False)
+    p2 = F.capture("second", probe_devices=False)
+    assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+
+
+def test_watchdog_fires_and_cancel_suppresses(tmp_path):
+    fired = threading.Event()
+    wd = F.Watchdog(
+        0.2, "unit hang", out_dir=str(tmp_path), on_fire=lambda w: fired.set()
+    ).start()
+    assert fired.wait(20)
+    assert wd.fired and wd.capture_path and os.path.exists(wd.capture_path)
+    with open(wd.capture_path) as f:
+        assert json.load(f)["kind"] == "watchdog"
+
+    wd2 = F.Watchdog(0.3, "cancelled", out_dir=str(tmp_path))
+    with wd2:
+        pass
+    time.sleep(0.5)
+    assert not wd2.fired
+
+
+def test_hung_child_process_yields_forensics(tmp_path):
+    """The BENCH_r05 shape, end to end: a child wedges with its main thread
+    asleep in C; its watchdog THREAD still captures a FORENSICS_*.json
+    naming the wedged phase, and the parent (us) reads the diagnosis from
+    outside while the child is still hung."""
+    child = tmp_path / "hang_child.py"
+    child.write_text(
+        "import sys, time\n"
+        f"sys.path.insert(0, {ROOT!r})\n"
+        "from tendermint_tpu.libs import forensics as F\n"
+        f"F.configure({str(tmp_path)!r})\n"
+        "F.beat('mesh_rlc_submit')\n"
+        "F.Watchdog(0.3, 'child wedged in mesh_rlc_submit').start()\n"
+        "time.sleep(600)\n"
+    )
+    t0 = time.time()
+    proc = subprocess.Popen([sys.executable, str(child)])
+    try:
+        deadline = time.time() + 60
+        captures = []
+        while time.time() < deadline:
+            captures = F.find_captures(str(tmp_path), since_ts=t0 - 1)
+            if captures:
+                break
+            time.sleep(0.25)
+        assert captures, "hung child produced no FORENSICS_*.json"
+        assert proc.poll() is None, "child must still be hung while we read"
+        with open(captures[-1]) as f:
+            doc = json.load(f)
+        assert doc["wedged_phase"] == "mesh_rlc_submit"
+        assert doc["kind"] == "watchdog"
+        assert doc["pid"] == proc.pid
+        # the heartbeat ring is independently readable from outside too
+        hb_files = [n for n in os.listdir(tmp_path) if n.startswith("heartbeat_")]
+        assert hb_files
+        beats = F.Heartbeat.read(str(tmp_path / hb_files[0]))
+        assert beats[-1]["phase"] == "mesh_rlc_submit"
+    finally:
+        proc.kill()
+        proc.wait(30)
+
+
+def test_chaos_hang_hook_produces_forensics(tmp_path):
+    """Acceptance loop for the fault-injected hung flush: the PR 4 chaos
+    hang hook stalls a device entry point AFTER _device_fault stamped its
+    heartbeat, so the armed watchdog's capture names the wedged phase."""
+    from tendermint_tpu.chaos.device import DeviceFaultInjector
+    from tendermint_tpu.crypto import batch as B
+
+    F.configure(str(tmp_path))
+    inj = DeviceFaultInjector()
+    inj.arm_hang(1.5)
+    B.set_device_fault_hook(inj)
+    fired = threading.Event()
+    wd = F.Watchdog(
+        0.3, "flush wedged under chaos hang",
+        out_dir=str(tmp_path), on_fire=lambda w: fired.set(),
+    ).start()
+    try:
+        B._device_fault("rlc_submit")  # beats, then hangs in the hook
+    finally:
+        B.set_device_fault_hook(None)
+        wd.cancel()
+    assert fired.wait(20)
+    assert inj.fired == [("rlc_submit", "hang")]
+    with open(wd.capture_path) as f:
+        doc = json.load(f)
+    assert doc["wedged_phase"] == "rlc_submit"
+    assert doc["kind"] == "watchdog"
+
+
+def test_bench_forensics_for_kill_attaches_capture(tmp_path, monkeypatch):
+    """bench.py's parent-side hook: a hard-deadline kill report carries the
+    FORENSICS files the child left and the wedged phase from the newest."""
+    import bench
+
+    monkeypatch.setenv("TMTPU_FORENSICS_DIR", str(tmp_path))
+    t0 = time.time() - 5
+    F.configure(str(tmp_path))
+    F.beat("mesh_persig_submit")
+    F.capture("pre-kill", kind="watchdog", probe_devices=False)
+    out = bench._forensics_for_kill(t0)
+    assert out["forensics"]
+    assert out["wedged_phase"] == "mesh_persig_submit"
+    assert out["forensics_kind"] == "watchdog"
+    # nothing newer than the window: nothing attached
+    assert bench._forensics_for_kill(time.time() + 60) == {}
+
+
+def test_env_default_configures_in_fresh_process(tmp_path):
+    """TMTPU_FORENSICS_DIR alone (no configure() call) enables the
+    heartbeat, mirroring TMTPU_TRACE — how bench children and operators
+    opt in without code."""
+    code = (
+        "from tendermint_tpu.libs import forensics as F\n"
+        "assert F.enabled(), 'env default must configure forensics'\n"
+        "F.beat('probe')\n"
+        "print(F.heartbeat_path())\n"
+    )
+    env = dict(os.environ, TMTPU_FORENSICS_DIR=str(tmp_path))
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    hb_path = out.stdout.strip()
+    assert hb_path.startswith(str(tmp_path))
+    assert F.Heartbeat.read(hb_path)[-1]["phase"] == "probe"

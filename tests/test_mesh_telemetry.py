@@ -1,0 +1,202 @@
+"""Per-shard mesh telemetry (parallel/telemetry.py): record surfaces, the
+`mesh` block of verify_stats, the dedicated /debug/mesh route, and the
+tendermint_mesh_* series — the instrumentation the sharded 8-chip path
+never had while every MULTICHIP round died opaquely."""
+
+import pytest
+
+from tendermint_tpu.parallel import telemetry as TM
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    TM.reset()
+    yield
+    TM.reset()
+
+
+def _record_typical_flush(kind="rlc", ndev=8, lanes=256):
+    TM.record_flush(
+        kind,
+        ndev=ndev,
+        shard_lanes=lanes,
+        submit_s=0.003,
+        finish_s=0.040,
+        all_gather_bytes=ndev * 4 * 20 * 4,
+        devices=[f"cpu:{i}" for i in range(ndev)],
+        ok=True,
+    )
+
+
+def test_record_and_snapshot_roundtrip():
+    TM.record_mesh(("vals",), (8,), [f"cpu:{i}" for i in range(8)], "cpu")
+    TM.record_prepare(8, 256, 0.012)
+    TM.record_pad(2001, 2048)
+    _record_typical_flush()
+    TM.record_aot("hit")
+    TM.record_aot("miss")
+    s = TM.mesh_stats()
+    assert s["mesh"]["n_devices"] == 8
+    assert s["mesh"]["axes"] == {"vals": 8}
+    assert s["mesh"]["platform"] == "cpu"
+    assert s["flushes"] == {"rlc": 1}
+    lf = s["last_flush"]
+    assert lf["lanes_total"] == 8 * 256 and lf["shards"] == 8
+    assert lf["submit_ms"] == 3.0 and lf["finish_ms"] == 40.0
+    assert lf["ok"] is True
+    assert s["last_pad"]["pad_waste_fraction"] == pytest.approx(
+        (2048 - 2001) / 2048, abs=1e-4
+    )
+    assert s["last_prep"]["lanes_per_shard"] == 256
+    assert s["totals"]["all_gathers"] == 1
+    assert s["totals"]["all_gather_bytes"] == 8 * 4 * 20 * 4
+    assert s["totals"]["prep_calls"] == 1
+    assert s["aot_cache"] == {"hit": 1, "miss": 1}
+
+
+def test_reset_zeroes_aggregates():
+    _record_typical_flush()
+    TM.reset()
+    s = TM.mesh_stats()
+    assert s["flushes"] == {} and s["last_flush"] is None
+    assert s["totals"]["submit_seconds"] == 0.0
+
+
+def test_verify_stats_carries_mesh_block():
+    """ONE stats read covers single-chip and sharded pipelines: the `mesh`
+    block rides /debug/verify_stats (the full snapshot is /debug/mesh)."""
+    from tendermint_tpu.libs import trace as T
+
+    _record_typical_flush(kind="persig", ndev=2, lanes=16)
+    stats = T.verify_stats()
+    assert stats["mesh"]["flushes"] == {"persig": 1}
+    assert stats["mesh"]["last_flush"]["lanes_total"] == 32
+
+
+def test_verify_stats_serves_slope_samples_raw():
+    """Satellite: PR 6's slope_samples raw (k, seconds) pairs are re-fittable
+    from a live node's stats read, no bench rerun (previously bench-JSON
+    only)."""
+    from tendermint_tpu.libs import trace as T
+
+    T.reset_stats()
+    samples = [(1, 0.0101), (2, 0.0185), (4, 0.0352), (8, 0.0690)]
+    T.record_slope_samples(samples, slope_ms=8.4, fused=True, source="bench")
+    block = T.verify_stats()["slope_samples"]
+    fit = block["fit"]
+    assert fit["samples"] == [list(s) for s in samples]
+    assert fit["slope_ms"] == 8.4 and fit["fused"] is True
+    assert fit["source"] == "bench" and fit["recorded_at"] > 0
+    # re-fit from the served raw pairs reproduces the slope (the point)
+    xs = [k for k, _ in samples]
+    ys = [s for _, s in samples]
+    mx, my = sum(xs) / len(xs), sum(ys) / len(ys)
+    slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / sum(
+        (x - mx) ** 2 for x in xs
+    )
+    assert slope * 1e3 == pytest.approx(8.4, abs=0.2)
+    # live per-flush rlc samples accumulate in the bounded ring
+    T.record_flush(backend="cpu", path="rlc", n=100, total_s=0.05)
+    flush_samples = T.verify_stats()["slope_samples"]["flush_samples"]
+    assert [100, 0.05, "rlc"] in flush_samples
+    T.reset_stats()
+    assert T.verify_stats()["slope_samples"]["fit"] is None
+
+
+def test_make_mesh_records_mesh_block():
+    jax = pytest.importorskip("jax")
+    from tendermint_tpu.parallel.sharded import make_mesh
+
+    make_mesh()
+    s = TM.mesh_stats()
+    assert s["mesh"]["n_devices"] == len(jax.devices())
+    assert s["mesh"]["platform"] == "cpu"
+
+
+def test_debug_mesh_route():
+    import asyncio
+    from types import SimpleNamespace
+
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.rpc.server import RPCServer
+
+    TM.record_mesh(("vals",), (2,), ["cpu:0", "cpu:1"], "cpu")
+    _record_typical_flush(ndev=2, lanes=8)
+    rpc = RPCServer(SimpleNamespace(config=test_config(), metrics=None))
+    out = asyncio.run(rpc._debug_mesh({}))
+    assert out["mesh"]["n_devices"] == 2
+    assert out["flushes"] == {"rlc": 1}
+
+
+def test_mesh_series_exposed_in_global_registry():
+    from tendermint_tpu.libs import metrics as M
+
+    _record_typical_flush(ndev=2, lanes=8)
+    TM.record_aot("corrupt")
+    text = M.global_registry().expose()
+    assert "tendermint_mesh_flushes_total" in text
+    assert 'result="corrupt"' in text
+    assert 'device="cpu:0"' in text
+
+
+# same lane as test_sharded.py: heavy one-time compiles, out of tier-1
+@pytest.mark.kernel
+@pytest.mark.slow
+@pytest.mark.heavy
+def test_sharded_flush_telemetry_from_batch_routing(monkeypatch):
+    """End to end through the production routing: a sharded RLC verify
+    records the pad decision (crypto/batch knows the real batch size;
+    sharded.py only ever sees padded arrays) and the per-shard flush.
+    Same n=24 shape as test_sharded.py so the compile cache is shared."""
+    jax = pytest.importorskip("jax")
+    if len(jax.devices("cpu")) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.crypto.keys import gen_ed25519
+
+    monkeypatch.setenv("TMTPU_SHARDED", "1")
+    monkeypatch.setattr(B, "_SHARDED_RUNNER", None)
+    monkeypatch.setattr(B, "RLC_MIN", 1)
+    n = 24
+    pubkeys, msgs, sigs = [], [], []
+    for i in range(n):
+        priv = gen_ed25519(bytes([i % 250 + 1]) * 32)
+        m = b"rlc-shard-%04d" % i
+        pubkeys.append(priv.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(priv.sign(m))
+    mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+    assert mask.all() and B.LAST_JAX_PATH[0] == "rlc-sharded"
+    s = TM.mesh_stats()
+    assert s.get("last_pad"), "sharded routing must record the pad decision"
+    assert s["last_pad"]["requested_lanes"] == 2 * n + 1
+    assert s["flushes"].get("rlc", 0) >= 1
+    assert s["last_flush"]["kind"] == "rlc"
+    assert s["last_flush"]["submit_ms"] >= 0
+    assert s["totals"]["all_gathers"] >= 1
+    B._SHARDED_RUNNER = None
+
+
+def test_corrupt_aot_artifact_counts_corrupt_not_miss(tmp_path, monkeypatch):
+    """hit/miss/corrupt are disjoint per call: a corrupted artifact must
+    increment only `corrupt` (deleted + re-exported), never also `miss` —
+    double-counting would inflate the very counter a MULTICHIP post-mortem
+    uses to tell a healthy cold start from artifact damage."""
+    import jax
+    import numpy as np
+
+    from tendermint_tpu.ops import aot_cache
+
+    monkeypatch.setattr(aot_cache, "_cache_dir", lambda: str(tmp_path))
+    fn = jax.jit(lambda x: x + 3)
+    x = np.arange(8, dtype=np.int32)
+
+    assert (np.asarray(aot_cache.call("corrupt_t", fn, x)) == x + 3).all()
+    assert TM.mesh_stats()["aot_cache"] == {"miss": 1}
+
+    [artifact] = tmp_path.iterdir()
+    artifact.write_bytes(b"not an export blob")
+    with aot_cache._LOCK:
+        aot_cache._MEM.clear()  # force the disk path again
+    assert (np.asarray(aot_cache.call("corrupt_t", fn, x)) == x + 3).all()
+    assert TM.mesh_stats()["aot_cache"] == {"miss": 1, "corrupt": 1}

@@ -171,6 +171,10 @@ METRICS_SETS = (
     M.BatchVerifyMetrics,
     M.PubSubMetrics,
     M.ChaosMetrics,
+    # device/mesh observatory (ISSUE 7): the tendermint_mesh_* series fed by
+    # parallel/telemetry.py and the profiler/forensics usage counters
+    M.MeshMetrics,
+    M.ObservatoryMetrics,
 )
 
 

@@ -1,0 +1,169 @@
+"""On-demand profiler capture (libs/profiler.py) and the offline analyzer
+(tools/profile_report.py): the start→stop round-trip on the CPU backend and
+the per-stage attribution table — acceptance for the observatory's layer 1.
+The CPU caveat (docs/OBSERVABILITY.md): the capture carries host/XLA:CPU
+spans but no device plane; the PIPELINE is identical on real accelerators,
+which is exactly what these tests pin."""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from tendermint_tpu.libs import profiler
+from tendermint_tpu.tools import profile_report
+
+
+def _flush_once():
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(1024.0)
+    return jax.block_until_ready(jnp.dot(x, x))
+
+
+def test_start_stop_roundtrip_on_cpu_backend(tmp_path):
+    info = profiler.start(str(tmp_path))
+    assert info["active"] and info["dir"].startswith(str(tmp_path))
+    st = profiler.status()
+    assert st["active"] and st["running_s"] >= 0
+    with pytest.raises(profiler.ProfilerError):
+        profiler.start(str(tmp_path))  # one session per process
+    _flush_once()
+    out = profiler.stop()
+    assert out["active"] is False and out["duration_s"] >= 0
+    assert out["artifacts"], "CPU-backend capture must still produce artifacts"
+    st = profiler.status()
+    assert not st["active"] and st["last_capture"]["dir"] == out["dir"]
+    with pytest.raises(profiler.ProfilerError):
+        profiler.stop()  # stop when idle is an error, not a no-op
+
+    # the captured trace renders a per-stage table in one command
+    rep = profile_report.report(out["dir"])
+    assert rep["events"] > 0 and rep["stages"]
+    md = profile_report.render_markdown(rep)
+    assert "| stage |" in md and "## Top ops" in md
+
+
+def test_trace_function_one_flush_capture(tmp_path):
+    result, run_dir = profiler.trace_function(
+        _flush_once, base_dir=str(tmp_path)
+    )
+    assert float(result) > 0  # the traced fn's result comes back
+    assert profile_report.find_capture_files(run_dir)
+    rep = profile_report.report(run_dir, top=5)
+    assert len(rep["ops"]) <= 5
+
+
+def _write_chrome_trace(path, events):
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def test_profile_report_stage_classification_and_self_times(tmp_path):
+    """Parser unit test on a synthetic perfetto trace: fused-stage names
+    classify into the PERF.md stages, and `self` excludes nested children."""
+    _write_chrome_trace(tmp_path / "x.trace.json.gz", [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 2,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "X", "name": "fused_uptree_pass2", "pid": 1, "tid": 2,
+         "ts": 0, "dur": 500},
+        {"ph": "X", "name": "fenwick_reduce.3", "pid": 1, "tid": 2,
+         "ts": 500, "dur": 300},
+        {"ph": "X", "name": "bucket_fold_kernel", "pid": 1, "tid": 2,
+         "ts": 800, "dur": 100},
+        {"ph": "X", "name": "persig_ladder", "pid": 1, "tid": 2,
+         "ts": 900, "dur": 50},
+        # nesting on another thread: outer 1000us contains inner 400us
+        {"ph": "X", "name": "outer_op", "pid": 1, "tid": 3, "ts": 0,
+         "dur": 1000},
+        {"ph": "X", "name": "inner_op", "pid": 1, "tid": 3, "ts": 100,
+         "dur": 400},
+    ])
+    rep = profile_report.report(str(tmp_path))
+    stages = {s["name"]: s for s in rep["stages"]}
+    assert stages["uptree"]["total_us"] == 500
+    assert stages["fenwick_reduce"]["total_us"] == 300
+    assert stages["bucket_fold"]["total_us"] == 100
+    assert stages["persig"]["total_us"] == 50
+    ops = {o["name"]: o for o in rep["ops"]}
+    assert ops["outer_op"]["total_us"] == 1000
+    assert ops["outer_op"]["self_us"] == 600  # minus the nested inner
+    assert ops["inner_op"]["self_us"] == 400
+    # plane names resolved from the M metadata events
+    assert any(p["plane"] == "/device:TPU:0" for p in rep["planes"])
+
+
+def test_profile_report_parses_xplane_artifacts(tmp_path):
+    """The xplane.pb protobuf walker parses a REAL capture's artifact (no
+    tensorflow/tensorboard in this container — the walker is our only
+    reader) and agrees with the capture's own artifact list."""
+    _, run_dir = profiler.trace_function(_flush_once, base_dir=str(tmp_path))
+    xplanes = [
+        os.path.join(dp, fn)
+        for dp, _, fns in os.walk(run_dir)
+        for fn in fns if fn.endswith(".xplane.pb")
+    ]
+    if not xplanes:
+        pytest.skip("jax build wrote no xplane artifact")
+    events = profile_report.load_events(xplanes[0])
+    assert events, "xplane walker must decode events from a real capture"
+    assert all(e["dur_us"] >= 0 for e in events)
+
+
+def test_report_errors_on_empty_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        profile_report.report(str(tmp_path))
+    assert profile_report.main([str(tmp_path)]) == 2
+
+
+def test_classify_first_match_wins():
+    assert profile_report.classify("fused_uptree_x") == "uptree"
+    assert profile_report.classify("jit_rlc_msm") == "msm_other"
+    assert profile_report.classify("TransferToDevice") == "transfer"
+    assert profile_report.classify("$SomePythonFrame") == "host_python"
+    assert profile_report.classify("mystery") == "other"
+
+
+def test_debug_device_profile_route(tmp_path):
+    """GET /debug/device_profile?action=start|stop|status against a live
+    RPCServer handler: the operator surface for profiling a running node."""
+    import asyncio
+    from types import SimpleNamespace
+
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.rpc.server import RPCServer
+
+    cfg = test_config()
+    cfg.instrumentation.profile_dir = str(tmp_path)
+    rpc = RPCServer(SimpleNamespace(config=cfg, metrics=None))
+
+    async def run():
+        st = await rpc._debug_device_profile({})
+        assert st["active"] is False
+        # start/stop are unsafe-gated (they mutate process-global profiler
+        # state); status above served fine without it
+        cfg.rpc.unsafe = False
+        with pytest.raises(ValueError, match="unsafe"):
+            await rpc._debug_device_profile({"action": "start"})
+        cfg.rpc.unsafe = True
+        out = await rpc._debug_device_profile({"action": "start"})
+        assert out["active"] and out["dir"].startswith(str(tmp_path))
+        _flush_once()
+        out = await rpc._debug_device_profile({"action": "stop"})
+        assert not out["active"] and out["artifacts"]
+        with pytest.raises(ValueError):
+            await rpc._debug_device_profile({"action": "bogus"})
+
+    asyncio.run(run())
+
+
+def test_profiler_actions_counted():
+    from tendermint_tpu.libs import metrics as M
+
+    text = M.global_registry().expose()
+    # the round-trips above incremented start/stop at least once each
+    assert "tendermint_profiler_actions_total" in text

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Standalone runner for the perf-trajectory ledger.
+
+Aggregates BENCH_r*.json / MULTICHIP_r*.json into one markdown + JSON
+trajectory table with lost-datapoint flags and a headline budget check; the
+implementation lives in tendermint_tpu/tools/perf_ledger.py. Usage:
+
+    python tools/perf_ledger.py [--root DIR] [--json OUT] [--check]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from tendermint_tpu.tools.perf_ledger import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

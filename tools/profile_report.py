@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Standalone runner for the profiler-trace analyzer.
+
+Renders a per-kernel / per-fused-stage time table from a libs/profiler.py
+capture directory (or any jax profile dump); the implementation lives in
+tendermint_tpu/tools/profile_report.py. Usage:
+
+    python tools/profile_report.py <capture-dir-or-file> [--top N] [--json OUT]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from tendermint_tpu.tools.profile_report import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
