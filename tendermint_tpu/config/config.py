@@ -201,6 +201,37 @@ class OverloadConfig:
 
 
 @dataclass
+class SLOConfig:
+    """Declared latency budgets + burn-rate guard policy (libs/slo.py; no
+    reference counterpart — the reference leaves SLOs to external alerting).
+    Budgets are seconds; an observation over budget is a breach, and an
+    error-budget burn rate >= burn_rate_trip over BOTH windows trips the
+    objective's guard (tendermint_slo_tripped / GET /debug/slo). Defaults
+    are sized for a LAN-ish production net; soaks tighten them to prove
+    trips and loosen them to prove compliance."""
+
+    enabled: bool = True
+    # target compliance ratio: 1 - target is the error budget
+    target: float = 0.99
+    # multi-window burn-rate evaluation (seconds) and trip threshold
+    window_fast: float = 60.0
+    window_slow: float = 600.0
+    burn_rate_trip: float = 4.0
+    # minimum observations in the fast window before a trip can fire (one
+    # slow block on an idle chain must not page)
+    min_samples: int = 6
+    # -- budgets (seconds) --
+    # origin-stamp -> first local receipt of a proposal (skew-corrected)
+    proposal_propagation: float = 1.0
+    # proposal timestamp -> +2/3 prevote quorum
+    prevote_quorum_delay: float = 2.0
+    # consecutive committed block timestamps
+    commit_interval: float = 15.0
+    # one batch-verify flush, any backend
+    verify_flush_wall: float = 2.0
+
+
+@dataclass
 class ConsensusConfig:
     wal_path: str = "data/cs.wal/wal"
     timeout_propose: float = 3.0
@@ -288,11 +319,13 @@ class InstrumentationConfig:
     # GET /debug/device_profile) write run dirs here; empty = a tmtpu_profiles
     # dir under the system temp dir.
     profile_dir: str = ""
-    # Stall forensics (libs/forensics.py): when set, device entry points
-    # heartbeat phase stamps into an mmap'd ring under this dir and
-    # FORENSICS_*.json captures land there. Empty = disabled (the
+    # Stall forensics (libs/forensics.py): device entry points heartbeat
+    # phase stamps into an mmap'd ring under this dir and FORENSICS_*.json
+    # captures land there — NEVER the repo/app root (ISSUE 8 satellite).
+    # Relative paths resolve under root_dir when one is set. Node start
+    # sweeps heartbeat files left by dead pids. Empty = disabled (the
     # TMTPU_FORENSICS_DIR env default still applies).
-    forensics_dir: str = ""
+    forensics_dir: str = "./forensics"
 
 
 @dataclass
@@ -304,6 +337,7 @@ class Config:
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
     overload: OverloadConfig = field(default_factory=OverloadConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)

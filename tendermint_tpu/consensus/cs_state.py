@@ -99,6 +99,7 @@ class ConsensusState:
         priv_validator=None,
         metrics=None,
         timeline=None,
+        slo=None,
     ):
         self.config = config
         self.metrics = metrics
@@ -106,6 +107,10 @@ class ConsensusState:
         # GET /debug/consensus_timeline; recording is gated on tracer.enabled
         # so a disabled recorder costs the hot path only flag checks
         self.timeline = timeline
+        # SLO engine (libs/slo.py): commit-interval and prevote-quorum-delay
+        # observations feed it here; the reactor feeds proposal propagation
+        # through this same reference (self.cs.slo)
+        self.slo = slo
         # (height, round, step, perf_counter) of the current step, and
         # (height, round, perf_counter) of the current round — the clocks
         # behind step_duration_seconds / round_duration_seconds
@@ -978,6 +983,14 @@ class ConsensusState:
                 m.block_interval_seconds.observe(
                     max(0.0, (block.header.time_ns - self.state.last_block_time_ns) / 1e9)
                 )
+        if (
+            self.slo is not None and not self.replay_mode
+            and self.state.last_block_height > 0
+        ):
+            self.slo.observe(
+                "commit_interval",
+                max(0.0, (block.header.time_ns - self.state.last_block_time_ns) / 1e9),
+            )
         fail.fail_point("cs_before_save_block")
         if self.block_store.height < block.header.height:
             seen_commit = precommits.make_commit()
@@ -1245,7 +1258,7 @@ class ConsensusState:
         (height, round) so trailing prevotes don't inflate the value."""
         rs = self.rs
         if (
-            self.metrics is None or self.replay_mode
+            (self.metrics is None and self.slo is None) or self.replay_mode
             or rs.proposal is None or rs.proposal.round != vround
         ):
             return
@@ -1253,10 +1266,14 @@ class ConsensusState:
         key = (rs.height, vround)
         if block_id is not None and self._quorum_prevote_marked != key:
             self._quorum_prevote_marked = key
-            self.metrics.quorum_prevote_delay.set(delay)
+            if self.metrics is not None:
+                self.metrics.quorum_prevote_delay.set(delay)
+            if self.slo is not None:
+                self.slo.observe("prevote_quorum_delay", delay)
         if prevotes.has_all() and self._full_prevote_marked != key:
             self._full_prevote_marked = key
-            self.metrics.full_prevote_delay.set(delay)
+            if self.metrics is not None:
+                self.metrics.full_prevote_delay.set(delay)
 
     def _sign_vote(self, msg_type: SignedMsgType, block_hash: bytes, psh: PartSetHeader) -> Optional[Vote]:
         rs = self.rs

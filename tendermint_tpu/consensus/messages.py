@@ -297,6 +297,57 @@ class VoteSetBitsMessage:
         return cls(height, round_, SignedMsgType(t), bid, votes)
 
 
+@dataclass(frozen=True)
+class TraceContext:
+    """Cross-node block-lifecycle trace metadata riding the p2p envelope
+    (chain observatory, ISSUE 8): origin node id, origin wall clock, and hop
+    count. Stamped by the SENDER of a consensus message; every receiver can
+    then record per-hop propagation latency (skew-corrected against the
+    direct peer's ping/pong clock-skew estimate) into the consensus
+    timeline. Encoded as envelope field TRACE_FIELD, APPENDED AFTER the
+    variant field — decoders that don't know it (the WAL replayer, old
+    peers) return at the variant field and never see it, so the wire format
+    stays backward- and forward-compatible."""
+
+    origin: str  # origin node id (hex, p2p/key.py NodeKey.id)
+    origin_ts: float  # wall-clock seconds at the origin's FIRST send
+    hops: int = 0  # 0 = direct from the origin; +1 per relay
+
+    TRACE_FIELD = 15
+
+    def encode(self) -> bytes:
+        cached = self.__dict__.get("_enc")
+        if cached is not None:
+            return cached
+        w = pw.Writer()
+        w.bytes_field(1, self.origin.encode())
+        w.varint_field(2, int(self.origin_ts * 1e6))
+        w.varint_field(3, self.hops)
+        data = w.bytes()
+        object.__setattr__(self, "_enc", data)
+        return data
+
+    @classmethod
+    def decode(cls, data: bytes) -> "TraceContext":
+        origin, ts_us, hops = "", 0, 0
+        for f, _, v in pw.Reader(data):
+            if f == 1:
+                origin = v.decode(errors="replace")
+            elif f == 2:
+                ts_us = pw.int64_from_varint(v)
+            elif f == 3:
+                hops = pw.int64_from_varint(v)
+        return cls(origin, ts_us / 1e6, hops)
+
+    def forwarded(self) -> "TraceContext":
+        """The context a relaying node stamps on re-gossip: same origin and
+        origin time, one more hop."""
+        return TraceContext(self.origin, self.origin_ts, self.hops + 1)
+
+
+_TAG_TRACE = pw.tag(TraceContext.TRACE_FIELD, pw.BYTES)
+
+
 _MESSAGE_TYPES = {
     cls.FIELD: cls
     for cls in (
@@ -313,24 +364,30 @@ _MESSAGE_TYPES = {
 }
 
 
-def encode_message(msg) -> bytes:
+def encode_message(msg, trace: Optional[TraceContext] = None) -> bytes:
     if type(msg) is VoteMessage:
         # The envelope memo lives on the VOTE (deeply immutable), not the
         # per-send VoteMessage wrapper: one vote is wrapped freshly for its
         # WAL frame and for EVERY peer it is gossiped to, but the bytes are
-        # identical — one build total.
+        # identical — one build total. The memo is TRACE-FREE: the trace
+        # suffix is appended outside it (TraceContext.encode is itself
+        # memoized, so a traced gossip send costs two concats, not a
+        # re-encode of the vote).
         vote = msg.vote
-        cached = vote.__dict__.get("_vote_msg_env")
-        if cached is not None:
-            return cached
+        env = vote.__dict__.get("_vote_msg_env")
+        if env is None:
+            w = pw.Writer()
+            w.message_field(VoteMessage.FIELD, vote.encode(), always=True)
+            env = w.bytes()
+            object.__setattr__(vote, "_vote_msg_env", env)
+    else:
         w = pw.Writer()
-        w.message_field(VoteMessage.FIELD, vote.encode(), always=True)
-        data = w.bytes()
-        object.__setattr__(vote, "_vote_msg_env", data)
-        return data
-    w = pw.Writer()
-    w.message_field(msg.FIELD, msg.encode_body(), always=True)
-    return w.bytes()
+        w.message_field(msg.FIELD, msg.encode_body(), always=True)
+        env = w.bytes()
+    if trace is None:
+        return env
+    tb = trace.encode()
+    return env + _TAG_TRACE + pw.encode_varint(len(tb)) + tb
 
 
 def decode_message(data: bytes):
@@ -339,6 +396,24 @@ def decode_message(data: bytes):
         if cls is not None:
             return cls.decode_body(v)
     raise ValueError("unknown consensus message")
+
+
+def decode_message_traced(data: bytes):
+    """(message, TraceContext or None). Unlike decode_message — which
+    returns at the variant field and is what the WAL replayer keeps using —
+    this walks every envelope field so the trailing trace is recovered."""
+    msg = None
+    trace = None
+    for f, _, v in pw.Reader(data):
+        if f == TraceContext.TRACE_FIELD:
+            trace = TraceContext.decode(v)
+            continue
+        cls = _MESSAGE_TYPES.get(f)
+        if cls is not None and msg is None:
+            msg = cls.decode_body(v)
+    if msg is None:
+        raise ValueError("unknown consensus message")
+    return msg, trace
 
 
 def _pack_bits(bits: List[bool]) -> bytes:

@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from tendermint_tpu.consensus.cs_state import ConsensusState
@@ -27,10 +28,11 @@ from tendermint_tpu.consensus.messages import (
     NewValidBlockMessage,
     ProposalMessage,
     ProposalPOLMessage,
+    TraceContext,
     VoteMessage,
     VoteSetBitsMessage,
     VoteSetMaj23Message,
-    decode_message,
+    decode_message_traced,
     encode_message,
 )
 from tendermint_tpu.consensus.round_state import RoundStepType
@@ -54,7 +56,26 @@ VOTE_CHANNEL = 0x22
 VOTE_SET_BITS_CHANNEL = 0x23
 
 GOSSIP_SLEEP = 0.02  # reference: config PeerGossipSleepDuration 100ms; tests are faster
+
+# a trace stamp older than this measures catch-up/retransmission (the
+# receiver's lag), not gossip propagation: count the message, drop the latency
+STALE_TRACE_S = 30.0
 QUERY_MAJ23_SLEEP = 0.5
+
+
+def propagation_latency(recv_ts: float, origin_ts: float, skew) -> float:
+    """Skew-corrected per-hop propagation latency in seconds.
+
+    `origin_ts` lives in the ORIGIN node's wall-clock domain; `skew` is the
+    origin's remote-minus-local offset estimated from timestamped ping/pong
+    (p2p/conn/connection.py), so the origin's local send time is
+    origin_ts - skew and latency = recv_ts - origin_ts + skew. Clamped at
+    zero: residual skew error (±RTT/2) must never fabricate negative
+    latency — honesty over precision."""
+    lat = recv_ts - origin_ts
+    if skew is not None:
+        lat += skew
+    return max(0.0, lat)
 
 
 class PeerState:
@@ -224,6 +245,9 @@ class ConsensusReactor(Reactor):
         self.wait_sync = wait_sync  # True while fast-sync is running
         self._tasks: List[asyncio.Task] = []
         self._peer_tasks: Dict[str, List[asyncio.Task]] = {}
+        # (height, round) proposals already seen once — bounds the first-
+        # receipt dedupe behind the propagation SLO (chain observatory)
+        self._prop_seen: "OrderedDict[tuple, None]" = OrderedDict()
 
     def get_channels(self) -> List[ChannelDescriptor]:
         # NEVER sheddable: the overload shed order is txs -> non-critical
@@ -275,7 +299,10 @@ class ConsensusReactor(Reactor):
         ps = PeerState(peer.id)
         peer.set("cs_peer_state", ps)
         # announce our current state
-        await peer.send(STATE_CHANNEL, encode_message(self._our_round_step()))
+        await peer.send(
+            STATE_CHANNEL,
+            encode_message(self._our_round_step(), trace=self._fresh_trace()),
+        )
         if not self.wait_sync:
             self._peer_tasks[peer.id] = [
                 asyncio.create_task(self._gossip_data_routine(peer, ps)),
@@ -287,11 +314,155 @@ class ConsensusReactor(Reactor):
         for t in self._peer_tasks.pop(peer.id, []):
             t.cancel()
 
+    # -- trace propagation (chain observatory, ISSUE 8) ---------------------
+
+    def _self_id(self) -> str:
+        sw = self.switch
+        if sw is None:
+            return ""
+        try:
+            return sw.node_info.node_id
+        except Exception:
+            return ""
+
+    def _fresh_trace(self) -> TraceContext:
+        """Origin stamp for a message WE generate right now (NewRoundStep,
+        HasVote): hops 0, wall clock now."""
+        return TraceContext(self._self_id(), time.time(), 0)
+
+    def _otrace(self, payload) -> TraceContext:
+        """Outbound trace for a gossiped payload (vote/proposal/part):
+        self-originated objects get ONE origin stamp at first send (memoized
+        — every peer sees the same origin time), relayed objects forward the
+        received context with the hop count bumped."""
+        rx = getattr(payload, "_rx_trace", None)
+        if rx is not None:
+            fwd = payload.__dict__.get("_fwd_trace")
+            if fwd is None:
+                fwd = rx.forwarded()
+                object.__setattr__(payload, "_fwd_trace", fwd)
+            return fwd
+        mine = payload.__dict__.get("_origin_trace")
+        if mine is None:
+            mine = self._fresh_trace()
+            object.__setattr__(payload, "_origin_trace", mine)
+        return mine
+
+    def _note_trace(self, msg, tctx: TraceContext, peer) -> None:
+        """A traced message arrived: stash the context on the payload (so a
+        re-gossip forwards it hop-bumped) and record per-hop propagation
+        latency — skew-corrected against the origin's ping/pong clock-skew
+        estimate when the origin is a direct peer, else against the relaying
+        peer's (the best available proxy on a multi-hop path).
+
+        The stamp is remote-supplied and arrives BEFORE consensus
+        validation, so recording is defensive: per-height timeline entries
+        only for heights adjacent to our own (a peer must not flush the
+        ring with invented heights), and stamps older than STALE_TRACE_S
+        record counts but never latency — catch-up/retransmitted gossip
+        measures the RECEIVER's lag, and must not poison the origin's."""
+        recv_ts = time.time()
+        payload = kind = None
+        if isinstance(msg, VoteMessage):
+            payload, kind = msg.vote, "vote"
+        elif isinstance(msg, BlockPartMessage):
+            payload, kind = msg.part, "block_part"
+        elif isinstance(msg, ProposalMessage):
+            payload, kind = msg.proposal, "proposal"
+        elif isinstance(msg, HasVoteMessage):
+            kind = "has_vote"
+        elif isinstance(msg, NewRoundStepMessage):
+            kind = "round_step"
+        else:
+            kind = "other"
+        if payload is not None:
+            try:
+                object.__setattr__(payload, "_rx_trace", tctx)
+            except Exception:
+                pass
+        tl = self.cs._tl()
+        slo = self.cs.slo
+        m = self.cs._live_metrics()
+        if tl is None and slo is None and m is None:
+            return
+        skew = None
+        sw = self.switch
+        if sw is not None:
+            try:
+                skew = sw.clock_skew(tctx.origin)
+            except Exception:
+                skew = None
+        if skew is None:
+            mc = getattr(peer, "mconn", None)
+            if mc is not None:
+                try:
+                    skew = mc.clock_skew()
+                except Exception:
+                    skew = None
+        lat = propagation_latency(recv_ts, tctx.origin_ts, skew)
+        stale = lat > STALE_TRACE_S
+        if tl is not None and not stale:
+            tl.record_hop(tctx.origin, kind, lat, skew_corrected=skew is not None)
+
+        def _height_ok(h: int) -> bool:
+            ours = self.cs.rs.height
+            return ours - 1 <= h <= ours + 1
+
+        if kind == "proposal":
+            p = msg.proposal
+            if not _height_ok(p.height):
+                return
+            first = self._mark_first_receipt(p.height, p.round)
+            if tl is not None:
+                # the timeline dedupes first-seen itself and counts the
+                # duplicate receipts
+                tl.record_proposal_propagation(
+                    p.height, p.round, tctx.origin, lat, tctx.hops, ts=recv_ts
+                )
+            if first and not stale:
+                # budget/histogram semantics are FIRST local receipt: each
+                # peer gossips the proposal independently, and a duplicate
+                # arriving late from a lagging peer is not propagation
+                if m is not None:
+                    m.proposal_propagation_seconds.observe(lat)
+                if slo is not None:
+                    slo.observe("proposal_propagation", lat)
+        elif kind == "block_part":
+            if not _height_ok(msg.height):
+                return
+            if tl is not None:
+                tl.record_block_part(
+                    msg.height, msg.round, None if stale else lat, ts=recv_ts
+                )
+        elif kind == "vote":
+            v = msg.vote
+            if not _height_ok(v.height):
+                return
+            if tl is not None:
+                tl.record_vote_origin(
+                    v.height, v.round, v.type.name, tctx.origin,
+                    None if stale else lat,
+                )
+            if m is not None and not stale:
+                m.vote_propagation_seconds.observe(lat)
+
+    def _mark_first_receipt(self, height: int, round_: int) -> bool:
+        """True exactly once per (height, round) proposal receipt; the seen
+        set is bounded (FIFO) so remote-supplied keys cannot grow it."""
+        key = (height, round_)
+        seen = self._prop_seen
+        if key in seen:
+            return False
+        seen[key] = None
+        while len(seen) > 256:
+            seen.popitem(last=False)
+        return True
+
     # -- receive -----------------------------------------------------------
 
     async def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
         try:
-            msg = decode_message(msg_bytes)
+            msg, tctx = decode_message_traced(msg_bytes)
         except Exception as e:
             logger.error("bad consensus msg from %s: %s", peer.id[:10], e)
             await self.switch.stop_peer_for_error(peer, e)
@@ -299,6 +470,11 @@ class ConsensusReactor(Reactor):
         ps: PeerState = peer.get("cs_peer_state")
         if ps is None:
             return
+        if tctx is not None:
+            try:
+                self._note_trace(msg, tctx, peer)
+            except Exception:
+                logger.exception("trace propagation recording failed")
         rs = self.cs.rs
 
         if chan_id == STATE_CHANNEL:
@@ -434,7 +610,10 @@ class ConsensusReactor(Reactor):
         async def on_steps(_msgs):
             # coalesced: broadcast our CURRENT round state once per drain
             if self.switch is not None:
-                await self.switch.broadcast(STATE_CHANNEL, encode_message(self._our_round_step()))
+                await self.switch.broadcast(
+                    STATE_CHANNEL,
+                    encode_message(self._our_round_step(), trace=self._fresh_trace()),
+                )
 
         async def on_valid(_msgs):
             rs = self.cs.rs
@@ -452,11 +631,13 @@ class ConsensusReactor(Reactor):
             if hs is not None:
                 t0 = _hotstats.perf_counter()
             payloads = []
+            trace = self._fresh_trace()  # one stamp for the whole drain batch
             for msg in msgs:
                 vote = msg.data.vote
                 payloads.append(
                     encode_message(
-                        HasVoteMessage(vote.height, vote.round, vote.type, vote.validator_index)
+                        HasVoteMessage(vote.height, vote.round, vote.type, vote.validator_index),
+                        trace=trace,
                     )
                 )
             await self.switch.broadcast_many(STATE_CHANNEL, payloads)
@@ -493,7 +674,10 @@ class ConsensusReactor(Reactor):
                         if part is not None:
                             ok = await peer.send(
                                 DATA_CHANNEL,
-                                encode_message(BlockPartMessage(rs.height, rs.round, part)),
+                                encode_message(
+                                    BlockPartMessage(rs.height, rs.round, part),
+                                    trace=self._otrace(part),
+                                ),
                             )
                             if ok:
                                 ps.set_has_proposal_block_part(rs.height, rs.round, idx)
@@ -506,7 +690,12 @@ class ConsensusReactor(Reactor):
                         continue
                 # 3. peer needs our proposal
                 if rs.proposal is not None and rs.height == ps.height and rs.round == ps.round and not ps.proposal:
-                    await peer.send(DATA_CHANNEL, encode_message(ProposalMessage(rs.proposal)))
+                    await peer.send(
+                        DATA_CHANNEL,
+                        encode_message(
+                            ProposalMessage(rs.proposal), trace=self._otrace(rs.proposal)
+                        ),
+                    )
                     ps.set_has_proposal(rs.proposal)
                     if 0 <= rs.proposal.pol_round:
                         pol = rs.votes.prevotes(rs.proposal.pol_round)
@@ -542,7 +731,10 @@ class ConsensusReactor(Reactor):
         if part is None:
             return False
         ok = await peer.send(
-            DATA_CHANNEL, encode_message(BlockPartMessage(ps.height, ps.round, part))
+            DATA_CHANNEL,
+            encode_message(
+                BlockPartMessage(ps.height, ps.round, part), trace=self._otrace(part)
+            ),
         )
         if ok:
             ps.proposal_block_parts.set_index(idx, True)
@@ -608,7 +800,10 @@ class ConsensusReactor(Reactor):
                 if picked:
                     sent_any = False
                     for vote in picked:
-                        ok = await peer.send(VOTE_CHANNEL, encode_message(VoteMessage(vote)))
+                        ok = await peer.send(
+                            VOTE_CHANNEL,
+                            encode_message(VoteMessage(vote), trace=self._otrace(vote)),
+                        )
                         if not ok:
                             break
                         sent_any = True

@@ -145,12 +145,57 @@ _HB_LOCK = threading.Lock()
 _OUT_DIR: Optional[str] = None
 _CAPTURE_SEQ = 0
 
+# Fallback runtime dir for captures when no directory was ever configured:
+# never litter the process cwd/repo root with FORENSICS_*.json (ISSUE 8
+# satellite; [instrumentation] forensics_dir defaults here too).
+DEFAULT_DIR = os.path.join(".", "forensics")
+
+_HB_NAME_RE = None  # compiled lazily (keep the import-time path tiny)
+
+
+def sweep_stale_heartbeats(directory: str) -> List[str]:
+    """Remove heartbeat_<pid>.bin files whose pid is DEAD (and not ours).
+    Returns the removed paths. A live ring is never touched — a concurrent
+    node in the same dir keeps its file; only the corpses of crashed or
+    SIGKILLed runs are swept (they accumulate one per pid otherwise)."""
+    import re
+
+    global _HB_NAME_RE
+    if _HB_NAME_RE is None:
+        _HB_NAME_RE = re.compile(r"^heartbeat_(\d+)\.bin$")
+    removed: List[str] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return removed
+    for name in names:
+        m = _HB_NAME_RE.match(name)
+        if m is None:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)
+            continue  # alive: leave its ring alone
+        except ProcessLookupError:
+            pass  # dead: sweep
+        except OSError:
+            continue  # exists but not ours to signal: leave it
+        try:
+            os.unlink(os.path.join(directory, name))
+            removed.append(os.path.join(directory, name))
+        except OSError:
+            pass
+    return removed
+
 
 def configure(directory: Optional[str], slots: int = DEFAULT_SLOTS) -> Optional[str]:
     """Enable (or with None disable) the process heartbeat under `directory`.
     Returns the heartbeat file path. Also sets the default FORENSICS_*.json
-    output directory. Wired from `[instrumentation] forensics_dir`
-    (node/node.py), the TMTPU_FORENSICS_DIR env default, and bench.py's
+    output directory and sweeps heartbeat rings left behind by dead pids.
+    Wired from `[instrumentation] forensics_dir` (node/node.py, default
+    ./forensics), the TMTPU_FORENSICS_DIR env default, and bench.py's
     scenario children."""
     global _HB, _OUT_DIR
     with _HB_LOCK:
@@ -164,7 +209,9 @@ def configure(directory: Optional[str], slots: int = DEFAULT_SLOTS) -> Optional[
         _HB = Heartbeat(
             os.path.join(directory, f"heartbeat_{os.getpid()}.bin"), slots
         )
-        return _HB.path
+        path = _HB.path  # read under the lock: a concurrent configure(None)
+    sweep_stale_heartbeats(directory)  # may clear _HB before we return
+    return path
 
 
 def enabled() -> bool:
@@ -297,7 +344,7 @@ def capture(
     if extra:
         doc["extra"] = extra
 
-    d = out_dir or _OUT_DIR or os.getcwd()
+    d = out_dir or _OUT_DIR or DEFAULT_DIR
     stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime(ts))
     with _HB_LOCK:
         global _CAPTURE_SEQ

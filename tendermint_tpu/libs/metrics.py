@@ -45,6 +45,22 @@ class _Metric:
     def _key(self) -> Tuple[str, ...]:
         return ()
 
+    def replace_series(self, values: Dict[Tuple[str, ...], float]) -> None:
+        """Atomically replace EVERY labeled series with `values` (label
+        tuple -> value). For gauges sampled from a live membership (e.g.
+        per-peer clock skew): departed members' series drop out instead of
+        exposing stale values and growing without bound over churn."""
+        clean = {
+            tuple(str(v) for v in k): float(val) for k, val in values.items()
+        }
+        for k in clean:
+            if len(k) != len(self.label_names):
+                raise ValueError(
+                    f"{self.name}: expected {len(self.label_names)} labels, got {len(k)}"
+                )
+        with self._lock:
+            self._values = clean
+
     def expose(self) -> List[str]:
         out = [
             f"# HELP {self.name} {self.help}",
@@ -348,6 +364,21 @@ class ConsensusMetrics:
             "proposal arrives) to each gossiped block part's arrival.",
             buckets=step_buckets,
         )
+        # cross-node trace propagation (chain observatory, ISSUE 8): per-hop
+        # latencies from the origin stamp carried in the p2p envelope,
+        # clock-skew corrected against the direct peer's ping/pong estimate
+        self.proposal_propagation_seconds = reg.histogram(
+            f"{ns}_proposal_propagation_seconds",
+            "Seconds from a proposal's origin stamp to its first local "
+            "receipt (skew-corrected).",
+            buckets=step_buckets,
+        )
+        self.vote_propagation_seconds = reg.histogram(
+            f"{ns}_vote_propagation_seconds",
+            "Seconds from a vote's origin stamp to its local receipt "
+            "(skew-corrected).",
+            buckets=step_buckets,
+        )
 
 
 class MempoolMetrics:
@@ -427,6 +458,14 @@ class P2PMetrics:
         self.rate_limit_disconnects = reg.counter(
             f"{ns}_rate_limit_disconnects_total",
             "Peers reported for persistent rate-limit misbehavior.",
+        )
+        # per-peer wall-clock skew from timestamped ping/pong (conn/
+        # connection.py), sampled by the switch's flowrate routine; the
+        # correction applied to cross-node propagation latencies
+        self.clock_skew_seconds = reg.gauge(
+            f"{ns}_clock_skew_seconds",
+            "Estimated remote-minus-local wall-clock offset per peer.",
+            ("peer",),
         )
 
 
@@ -715,6 +754,42 @@ class ObservatoryMetrics:
         )
 
 
+class SLOMetrics:
+    """SLO burn-rate engine accounting (libs/slo.py): declared budgets,
+    good/breach classification, per-window burn rates, and guard trips —
+    the tendermint_slo_* series a fleet dashboard alerts on. Node-local
+    (each node declares and evaluates its own budgets)."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_slo"
+        self.budget_seconds = reg.gauge(
+            f"{ns}_budget_seconds",
+            "Declared latency budget per objective ([slo] config).",
+            ("slo",),
+        )
+        self.observations = reg.counter(
+            f"{ns}_observations_total",
+            "Latency observations classified against their budget.",
+            ("slo", "verdict"),
+        )
+        self.burn_rate = reg.gauge(
+            f"{ns}_burn_rate",
+            "Error-budget burn rate per objective and window (1.0 consumes "
+            "the budget exactly at the target rate).",
+            ("slo", "window"),
+        )
+        self.tripped = reg.gauge(
+            f"{ns}_tripped",
+            "1 while the objective's multi-window burn-rate guard is tripped.",
+            ("slo",),
+        )
+        self.trips = reg.counter(
+            f"{ns}_trips_total",
+            "Burn-rate guard trips (armed-to-tripped transitions).",
+            ("slo",),
+        )
+
+
 class ChaosMetrics:
     """tendermint_tpu/chaos engine accounting: how many faults a soak/smoke
     injected per level. Exposed so a chaos run's /metrics scrape shows the
@@ -796,6 +871,7 @@ class NodeMetrics:
         self.statesync = StateSyncMetrics(self.registry)
         self.rpc = RPCMetrics(self.registry)
         self.overload = OverloadMetrics(self.registry)
+        self.slo = SLOMetrics(self.registry)
         NodeMetrics._latest = self
 
     @classmethod

@@ -221,6 +221,10 @@ def record_flush(
     resolved tracer (or None when tracing is disabled) so this function adds
     no tracer-flag reads of its own."""
     from tendermint_tpu.libs import metrics as _metrics
+    from tendermint_tpu.libs import slo as _slo
+
+    # SLO feed (verify_flush_wall): one None check when no engine registered
+    _slo.feed_flush(total_s)
 
     m = _metrics.batch_metrics()
     m.flushes.labels(backend, path).inc()

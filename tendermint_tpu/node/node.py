@@ -113,12 +113,28 @@ class Node:
 
         # stall forensics (libs/forensics.py): heartbeat the device entry
         # points + write FORENSICS_*.json captures under [instrumentation]
-        # forensics_dir; process-global like the tracer (the env default
-        # TMTPU_FORENSICS_DIR already applied at import if set)
-        if getattr(config.instrumentation, "forensics_dir", ""):
+        # forensics_dir (default ./forensics — never the app root); relative
+        # paths resolve under root_dir; process-global like the tracer (the
+        # env default TMTPU_FORENSICS_DIR already applied at import if set).
+        # Heartbeat rings left by DEAD pids are swept at configure time.
+        fdir = getattr(config.instrumentation, "forensics_dir", "")
+        if fdir:
             from tendermint_tpu.libs import forensics as _forensics
 
-            _forensics.configure(config.instrumentation.forensics_dir)
+            if not os.path.isabs(fdir) and config.root_dir:
+                fdir = os.path.join(config.root_dir, fdir)
+            _forensics.configure(fdir)
+
+        # SLO engine (libs/slo.py): declared latency budgets + burn-rate
+        # guards, served at GET /debug/slo and as tendermint_slo_* series.
+        # Node-local, but the batch-verify flush feed is process-global
+        # (set_default: last node wins, same model as the tracer).
+        self.slo = None
+        if getattr(config, "slo", None) is not None and config.slo.enabled:
+            from tendermint_tpu.libs import slo as _slo
+
+            self.slo = _slo.SLOEngine(config.slo, metrics=self.metrics.slo)
+            _slo.set_default(self.slo)
 
         # per-height/round consensus timeline ring (consensus/timeline.py) —
         # node-local (unlike the tracer), served by /debug/consensus_timeline;
@@ -238,6 +254,7 @@ class Node:
             priv_validator=priv_validator,
             metrics=self.metrics.consensus,
             timeline=self.timeline,
+            slo=self.slo,
         )
 
         self.rpc_server = None
@@ -541,6 +558,13 @@ class Node:
             self.priv_validator.close()
         self.mempool.close_wal()
         self.proxy_app.stop()
+        if self.slo is not None:
+            from tendermint_tpu.libs import slo as _slo
+
+            # don't leave a dead engine as the process-global flush feed
+            # (last-node-wins model: only deregister if it's still ours)
+            if _slo.default_engine() is self.slo:
+                _slo.set_default(None)
         for db in (self.block_db, self.state_db, self.evidence_db):
             db.close()
 

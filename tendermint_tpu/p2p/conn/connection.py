@@ -28,6 +28,10 @@ DEFAULT_SEND_RATE = 512000
 DEFAULT_RECV_RATE = 512000
 PING_INTERVAL = 60.0
 PONG_TIMEOUT = 45.0
+# first ping fires shortly after start (not after a full PING_INTERVAL) so a
+# fresh connection has a clock-skew estimate before consensus traffic needs
+# one (the chain observatory's propagation latencies are skew-corrected)
+PING_PRIME_DELAY = 0.25
 FLUSH_THROTTLE = 0.1
 
 # packet envelope fields (oneof): 1=ping 2=pong 3=msg{1:channel,2:eof,3:data}
@@ -164,6 +168,19 @@ class MConnection:
         self._send_event = asyncio.Event()
         self._pong_pending = False
         self._last_pong = time.monotonic()
+        # Clock-skew estimation (NTP-style, from timestamped ping/pong):
+        # ping carries our wall clock t0; the pong echoes it and adds the
+        # remote wall clock t2; at pong receipt t3 the remote-minus-local
+        # offset is t2 - (t0+t3)/2, uncertain by ±RTT/2. The minimum-RTT
+        # sample is kept (smallest uncertainty); later samples at equal-or-
+        # better RTT replace it, worse-RTT samples nudge it by EWMA so slow
+        # drift is still tracked. Legacy peers send empty ping/pong bodies —
+        # no sample, skew stays None, consumers fall back to uncorrected
+        # (clamped) latencies.
+        self._skew_s: Optional[float] = None
+        self._skew_rtt_s: Optional[float] = None
+        self._skew_samples = 0
+        self._ping_sent: Optional[tuple] = None  # (t0_us, monotonic at send)
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
         # inbound admission control: one token bucket per SHEDDABLE channel
@@ -237,6 +254,13 @@ class MConnection:
             "recv_rate_bytes": round(self._recv_monitor.status_rate(), 1),
             "send_bytes_total": self._send_monitor.total,
             "recv_bytes_total": self._recv_monitor.total,
+            "clock_skew_s": (
+                round(self._skew_s, 6) if self._skew_s is not None else None
+            ),
+            "clock_skew_rtt_s": (
+                round(self._skew_rtt_s, 6) if self._skew_rtt_s is not None else None
+            ),
+            "clock_skew_samples": self._skew_samples,
             "shed_msgs_total": self.shed_msgs,
             "shed_by_channel": {
                 f"{cid:#x}": n for cid, n in self.shed_by_channel.items()
@@ -252,6 +276,28 @@ class MConnection:
                 for ch in self._channels.values()
             ],
         }
+
+    def clock_skew(self) -> Optional[float]:
+        """Estimated REMOTE-minus-LOCAL wall-clock offset in seconds, or
+        None before the first timestamped pong (or against a legacy peer).
+        Cross-node propagation latencies subtract this so a peer with a
+        fast clock doesn't fabricate latency (and a slow one doesn't hide
+        it); the residual uncertainty is ±RTT/2 of the kept sample."""
+        return self._skew_s
+
+    def _record_skew_sample(self, t0_s: float, t2_s: float, t3_s: float, rtt_s: float) -> None:
+        """Fold one timestamped pong into the skew estimate (pure bookkeeping,
+        unit-tested directly): offset = t2 - (t0+t3)/2."""
+        offset = t2_s - (t0_s + t3_s) / 2.0
+        self._skew_samples += 1
+        if self._skew_rtt_s is None or rtt_s <= self._skew_rtt_s:
+            # better (or first) uncertainty bound: take the sample outright
+            self._skew_s = offset
+            self._skew_rtt_s = rtt_s
+        else:
+            # worse RTT: blend lightly so long-run clock DRIFT still moves
+            # the estimate without a lucky old sample pinning it forever
+            self._skew_s += 0.1 * (offset - self._skew_s)
 
     # -- internals ---------------------------------------------------------
 
@@ -330,13 +376,37 @@ class MConnection:
     async def _handle_packet(self, env: bytes) -> None:
         for f, _, v in pw.Reader(env):
             if f == _F_PING:
+                # echo the ping's timestamp (field 1) and add our wall clock
+                # (field 2) so the pinger can estimate clock skew; a legacy
+                # empty ping gets a legacy empty pong
+                t0_us = 0
+                for ff, _, vv in pw.Reader(v):
+                    if ff == 1:
+                        t0_us = pw.int64_from_varint(vv)
+                body = pw.Writer()
+                if t0_us:
+                    body.varint_field(1, t0_us)
+                    body.varint_field(2, int(time.time() * 1e6))
                 w = pw.Writer()
-                w.message_field(_F_PONG, b"", always=True)
+                w.message_field(_F_PONG, body.bytes(), always=True)
                 out = w.bytes()
                 await self._t.write(pw.encode_varint(len(out)) + out)
             elif f == _F_PONG:
                 self._last_pong = time.monotonic()
                 self._pong_pending = False
+                t0_us = t2_us = 0
+                for ff, _, vv in pw.Reader(v):
+                    if ff == 1:
+                        t0_us = pw.int64_from_varint(vv)
+                    elif ff == 2:
+                        t2_us = pw.int64_from_varint(vv)
+                sent = self._ping_sent
+                if t0_us and t2_us and sent is not None and sent[0] == t0_us:
+                    self._ping_sent = None
+                    rtt = max(0.0, time.monotonic() - sent[1])
+                    self._record_skew_sample(
+                        t0_us / 1e6, t2_us / 1e6, time.time(), rtt
+                    )
             elif f == _F_MSG:
                 chan_id, eof, data = 0, 0, b""
                 for ff, _, vv in pw.Reader(v):
@@ -395,11 +465,17 @@ class MConnection:
 
     async def _ping_routine(self) -> None:
         try:
+            first = True
             while not self._stopped:
-                await asyncio.sleep(PING_INTERVAL)
+                await asyncio.sleep(PING_PRIME_DELAY if first else PING_INTERVAL)
+                first = False
+                t0_us = int(time.time() * 1e6)
+                body = pw.Writer()
+                body.varint_field(1, t0_us)
                 w = pw.Writer()
-                w.message_field(_F_PING, b"", always=True)
+                w.message_field(_F_PING, body.bytes(), always=True)
                 out = w.bytes()
+                self._ping_sent = (t0_us, time.monotonic())
                 # Arm the flag BEFORE the write: the pong can arrive while the
                 # write awaits, and must not be lost (it would look like a
                 # timeout on a healthy connection).

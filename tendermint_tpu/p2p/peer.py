@@ -45,6 +45,11 @@ class Peer:
         Status -> ConnectionStatus); surfaced in net_info."""
         return self.mconn.status()
 
+    def clock_skew(self):
+        """Estimated remote-minus-local wall-clock offset (seconds) from the
+        connection's timestamped ping/pong, or None before the first sample."""
+        return self.mconn.clock_skew()
+
     def set(self, key: str, value) -> None:
         self._data[key] = value
 

@@ -122,6 +122,7 @@ class Switch:
             return
         send_rate = recv_rate = 0.0
         pending = 0
+        skews = {}
         for peer in self.peers.list():
             try:
                 st = peer.status()
@@ -130,9 +131,27 @@ class Switch:
             send_rate += st["send_rate_bytes"]
             recv_rate += st["recv_rate_bytes"]
             pending += sum(c["pending_messages"] for c in st["channels"])
+            if st.get("clock_skew_s") is not None:
+                skews[(peer.id[:10],)] = st["clock_skew_s"]
+        # replace, don't accumulate: a departed peer's series must drop out
+        # (peer ids are remote-controlled label cardinality)
+        self.metrics.clock_skew_seconds.replace_series(skews)
         self.metrics.send_rate_bytes.set(send_rate)
         self.metrics.recv_rate_bytes.set(recv_rate)
         self.metrics.pending_send_messages.set(pending)
+
+    def clock_skew(self, node_id: str):
+        """Remote-minus-local clock-skew estimate for a DIRECTLY connected
+        peer (seconds), or None when the peer is unknown or unsampled. The
+        chain observatory's propagation latencies subtract this before they
+        are recorded, so cross-node deltas are honest."""
+        peer = self.peers.get(node_id)
+        if peer is None:
+            return None
+        try:
+            return peer.clock_skew()
+        except Exception:
+            return None
 
     def set_conn_filter(self, fn) -> None:
         """Install (or clear, with None) a peer-id connection filter. Applies

@@ -122,6 +122,7 @@ class RPCServer:
         self.app.router.add_get("/websocket", self._handle_websocket)
         # flight-recorder dumps (libs/trace.py); two path segments, so they
         # need explicit routes ahead of the generic /{method} catch-all
+        self.app.router.add_get("/debug", self._handle_debug_index)
         self.app.router.add_get("/debug/trace", self._handle_debug_trace)
         self.app.router.add_get("/debug/verify_stats", self._handle_debug_verify_stats)
         self.app.router.add_get(
@@ -129,6 +130,7 @@ class RPCServer:
         )
         self.app.router.add_get("/debug/overload", self._handle_debug_overload)
         self.app.router.add_get("/debug/mesh", self._handle_debug_mesh)
+        self.app.router.add_get("/debug/slo", self._handle_debug_slo)
         self.app.router.add_get(
             "/debug/device_profile", self._handle_debug_device_profile
         )
@@ -178,6 +180,8 @@ class RPCServer:
             "consensus_timeline": self._consensus_timeline,
             "debug_overload": self._debug_overload,
             "debug_mesh": self._debug_mesh,
+            "debug_slo": self._debug_slo,
+            "debug_index": self._debug_index,
             "debug_device_profile": self._debug_device_profile,
         }
 
@@ -296,6 +300,18 @@ class RPCServer:
     async def _handle_debug_mesh(self, request: web.Request) -> web.Response:
         try:
             return web.json_response(_result(None, await self._debug_mesh({})))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
+
+    async def _handle_debug_slo(self, request: web.Request) -> web.Response:
+        try:
+            return web.json_response(_result(None, await self._debug_slo({})))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
+
+    async def _handle_debug_index(self, request: web.Request) -> web.Response:
+        try:
+            return web.json_response(_result(None, await self._debug_index({})))
         except Exception as e:
             return web.json_response(_error(None, -32603, "internal error", str(e)))
 
@@ -956,6 +972,14 @@ class RPCServer:
             "max_heights": tl.max_heights if tl is not None else 0,
             "count": len(heights),
             "heights": heights,
+            # cross-height per-origin hop-latency aggregates (the per-peer
+            # lag ranking the chain observatory merges across the fleet)
+            "propagation_peers": tl.peer_stats() if tl is not None else {},
+            "node_id": (
+                self.node.node_key.id
+                if getattr(self.node, "node_key", None) is not None
+                else None
+            ),
         }
 
     async def _debug_overload(self, params) -> dict:
@@ -1013,6 +1037,48 @@ class RPCServer:
         from tendermint_tpu.parallel import telemetry as mesh_tm
 
         return mesh_tm.mesh_stats()
+
+    # one-line description per debug surface — served by GET /debug so the
+    # ~10 endpoints are discoverable from the node itself, not only the docs
+    DEBUG_ENDPOINTS = (
+        ("/debug", "this index: every debug endpoint with a description", False),
+        ("/debug/trace", "flight-recorder ring dump (batch-verify spans + "
+         "consensus/breaker/forensics events); ?limit=N", False),
+        ("/debug/verify_stats", "aggregated batch-verify telemetry, last "
+         "flush breakdown, slope samples, device health", False),
+        ("/debug/consensus_timeline", "per-height/round timeline: steps, "
+         "proposals, vote arrivals, cross-node propagation; ?limit=N", False),
+        ("/debug/overload", "overload-protection snapshot: RPC gate, "
+         "pressure controller, mempool admission, per-peer sheds", False),
+        ("/debug/mesh", "multi-chip mesh telemetry: shard lanes, pad waste, "
+         "all_gather traffic, AOT cache outcomes", False),
+        ("/debug/slo", "declared latency budgets, per-window burn rates and "
+         "guard trips ([slo] config)", False),
+        ("/debug/device_profile", "on-demand jax profiler capture; "
+         "?action=start|stop|status (start/stop need rpc.unsafe)", True),
+        ("/metrics", "Prometheus exposition (needs instrumentation."
+         "prometheus)", False),
+    )
+
+    async def _debug_index(self, params) -> dict:
+        """GET /debug: machine- and operator-readable catalog of every debug
+        endpoint (they number ~10 and were only discoverable via docs)."""
+        return {
+            "endpoints": [
+                {"path": path, "description": desc, "unsafe": unsafe}
+                for path, desc, unsafe in self.DEBUG_ENDPOINTS
+            ]
+        }
+
+    async def _debug_slo(self, params) -> dict:
+        """SLO burn-rate snapshot (libs/slo.py): declared budgets, good/
+        breach totals, fast+slow window burn rates, tripped guards and
+        verdicts per objective. Read-only, served regardless of rpc.unsafe
+        (like /debug/verify_stats); enabled=false when the engine is off."""
+        eng = getattr(self.node, "slo", None)
+        if eng is None:
+            return {"enabled": False, "objectives": {}}
+        return eng.snapshot()
 
     async def _debug_device_profile(self, params) -> dict:
         """On-demand device profiler capture (libs/profiler.py over

@@ -1,0 +1,446 @@
+"""Chain observatory (ISSUE 8): cross-node trace propagation, skewed-clock
+honesty, timeline cross-node fields, and the fleet merge.
+
+Tier-1 throughout: the fixture-driven merge tests need no net at all, and
+the end-to-end test runs a fast 4-node plaintext in-process net (same
+harness as the chaos smoke) — real gossip, real trace stamps, real dumps,
+one merged report covering every node."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.config.config import SLOConfig
+from tendermint_tpu.consensus.messages import (
+    HasVoteMessage,
+    NewRoundStepMessage,
+    TraceContext,
+    decode_message,
+    decode_message_traced,
+    encode_message,
+)
+from tendermint_tpu.consensus.reactor import propagation_latency
+from tendermint_tpu.consensus.timeline import (
+    MAX_ORIGINS_PER_ROUND,
+    MAX_ROUNDS_PER_HEIGHT,
+    OVERFLOW_ORIGIN,
+    ConsensusTimeline,
+)
+from tendermint_tpu.libs import metrics as M
+from tendermint_tpu.libs.slo import SLOEngine
+from tendermint_tpu.tools import chain_observatory as obs
+from tendermint_tpu.types.basic import SignedMsgType
+
+NODE_A = "aa" * 20
+NODE_B = "bb" * 20
+NODE_C = "cc" * 20
+
+
+# ---------------------------------------------------------------------------
+# wire format: TraceContext on the consensus envelope
+
+
+def test_trace_context_roundtrip_and_forward():
+    t = TraceContext(NODE_A, 1722700000.123456, 0)
+    rt = TraceContext.decode(t.encode())
+    assert rt.origin == NODE_A
+    assert rt.hops == 0
+    # wall clock rides as integer microseconds
+    assert abs(rt.origin_ts - t.origin_ts) < 1e-5
+    f = t.forwarded()
+    assert (f.origin, f.hops) == (NODE_A, 1)
+    assert abs(f.origin_ts - t.origin_ts) < 1e-12
+    # encode is memoized per frozen instance
+    assert t.encode() is t.encode()
+
+
+def test_traced_envelope_backward_and_forward_compatible():
+    """The trace suffix must be invisible to the legacy decoder (WAL
+    replayer, old peers) and recoverable by the traced one; an untraced
+    envelope decodes with trace None."""
+    msg = NewRoundStepMessage(7, 0, 1, 3, -1)
+    plain = encode_message(msg)
+    traced = encode_message(msg, trace=TraceContext(NODE_B, 1722700001.5, 2))
+    # traced envelope = plain envelope + appended trace field
+    assert traced.startswith(plain)
+    assert len(traced) > len(plain)
+    # legacy decoder: same message, trace ignored
+    assert decode_message(traced) == msg
+    # traced decoder: both
+    m2, tctx = decode_message_traced(traced)
+    assert m2 == msg
+    assert tctx.origin == NODE_B and tctx.hops == 2
+    # untraced envelope through the traced decoder
+    m3, none = decode_message_traced(plain)
+    assert m3 == msg and none is None
+
+
+def test_has_vote_batch_shares_one_trace_stamp():
+    tr = TraceContext(NODE_A, 1722700002.0, 0)
+    msgs = [
+        HasVoteMessage(5, 0, SignedMsgType.PREVOTE, i) for i in range(3)
+    ]
+    payloads = [encode_message(m, trace=tr) for m in msgs]
+    for p, m in zip(payloads, msgs):
+        got, tctx = decode_message_traced(p)
+        assert got == m
+        assert tctx == tr
+
+
+# ---------------------------------------------------------------------------
+# skewed-clock honesty
+
+
+def test_propagation_latency_never_negative_after_skew_correction():
+    """A peer with a FAST clock stamps origin_ts in the future; without
+    correction the raw latency is negative. The skew estimate restores the
+    true latency, and residual error can never push the result below 0."""
+    # origin's clock runs 2s ahead: it stamped t=102 when true time was 100;
+    # we receive at 100.05 -> raw latency -1.95s
+    recv, origin_ts = 100.05, 102.0
+    # skew = remote - local = +2.0; corrected: 100.05 - 102.0 + 2.0 = 0.05
+    assert propagation_latency(recv, origin_ts, 2.0) == pytest.approx(0.05)
+    # no skew estimate (legacy peer): clamped, never negative
+    assert propagation_latency(recv, origin_ts, None) == 0.0
+    # over-correction (skew error past the true latency): still clamped
+    assert propagation_latency(recv, origin_ts, 1.9) == 0.0
+    # slow origin clock hides latency; correction restores it
+    assert propagation_latency(100.5, 99.0, -1.0) == pytest.approx(0.5)
+
+
+def test_skew_sample_min_rtt_wins_and_drift_tracks():
+    """MConnection keeps the minimum-RTT sample (tightest ±RTT/2 bound) and
+    only nudges by EWMA on worse-RTT samples so drift still tracks."""
+    from tendermint_tpu.p2p.conn.connection import MConnection
+
+    mc = object.__new__(MConnection)
+    mc._skew_s = None
+    mc._skew_rtt_s = None
+    mc._skew_samples = 0
+
+    # first sample: t0=10, t2=12.005, t3=10.01 -> offset = 12.005 - 10.005 = 2.0
+    mc._record_skew_sample(10.0, 12.005, 10.01, rtt_s=0.01)
+    assert mc.clock_skew() == pytest.approx(2.0)
+    assert mc._skew_rtt_s == 0.01
+
+    # worse-RTT sample with a wildly different offset: EWMA nudge only
+    mc._record_skew_sample(20.0, 25.0, 20.5, rtt_s=0.5)  # offset 4.75
+    assert 2.0 < mc.clock_skew() < 2.5
+    assert mc._skew_rtt_s == 0.01  # kept bound unchanged
+
+    # equal-or-better RTT: replaces outright
+    mc._record_skew_sample(30.0, 32.1, 30.002, rtt_s=0.002)
+    assert mc.clock_skew() == pytest.approx(32.1 - 30.001)
+    assert mc._skew_samples == 3
+
+
+# ---------------------------------------------------------------------------
+# timeline cross-node fields
+
+
+def test_timeline_proposal_first_seen_and_parts_fanout():
+    tl = ConsensusTimeline()
+    tl.record_proposal_propagation(5, 0, NODE_A, 0.040, hops=0, ts=100.0)
+    # a duplicate receipt later must not overwrite first-seen
+    tl.record_proposal_propagation(5, 0, NODE_B, 0.500, hops=1, ts=100.6)
+    tl.record_block_part(5, 0, latency_s=0.002, ts=100.01)
+    tl.record_block_part(5, 0, latency_s=0.020, ts=100.09)
+    rec = tl.dump()[0]
+    prop = rec["propagation"][0]
+    assert prop["proposal_first_seen_ms"] == 40.0
+    assert prop["proposal_origin"] == NODE_A
+    assert prop["proposal_hops"] == 0
+    assert prop["proposal_receipts"] == 2
+    assert prop["parts"] == 2
+    assert prop["parts_fanout_s"] == pytest.approx(0.08)
+    # 2ms lands in the <=5ms bucket, 20ms in the <=25ms bucket
+    assert prop["part_latency_ms"][1] == 1
+    assert prop["part_latency_ms"][3] == 1
+
+
+def test_timeline_vote_origin_histograms_and_cap():
+    tl = ConsensusTimeline()
+    tl.record_vote_origin(3, 0, "PREVOTE", NODE_A, latency_s=0.004)
+    tl.record_vote_origin(3, 0, "PREVOTE", NODE_A, latency_s=0.300)
+    tl.record_vote_origin(3, 0, "PRECOMMIT", NODE_B, latency_s=0.020)
+    votes = tl.dump()[0]["votes"][0]
+    a = votes["by_origin"][NODE_A]
+    assert a["prevote"] == 2 and a["precommit"] == 0
+    assert a["max_ms"] == 300.0
+    assert sum(a["latency_ms"]) == 2
+    assert votes["by_origin"][NODE_B]["precommit"] == 1
+
+    # remote-controlled cardinality is capped into the overflow bucket
+    tl2 = ConsensusTimeline()
+    for i in range(MAX_ORIGINS_PER_ROUND + 10):
+        tl2.record_vote_origin(1, 0, "PREVOTE", f"origin-{i:04d}", latency_s=0.001)
+    by_origin = tl2.dump()[0]["votes"][0]["by_origin"]
+    assert len(by_origin) == MAX_ORIGINS_PER_ROUND + 1
+    assert by_origin[OVERFLOW_ORIGIN]["prevote"] == 10
+
+    # round keys arrive from the wire before validation: capped per height
+    tl3 = ConsensusTimeline()
+    for r in range(MAX_ROUNDS_PER_HEIGHT + 10):
+        tl3.record_vote_origin(1, r, "PREVOTE", NODE_A, latency_s=0.001)
+        tl3.record_proposal_propagation(1, r, NODE_A, 0.01, ts=1.0)
+        tl3.record_block_part(1, r, latency_s=0.01, ts=1.0)
+    rec = tl3.dump()[0]
+    assert len(rec["votes"]) == MAX_ROUNDS_PER_HEIGHT
+    assert len(rec["propagation"]) == MAX_ROUNDS_PER_HEIGHT
+
+
+def test_timeline_peer_stats_ranking_and_skew_accounting():
+    tl = ConsensusTimeline()
+    for _ in range(4):
+        tl.record_hop(NODE_A, "vote", 0.002, skew_corrected=True)
+    tl.record_hop(NODE_B, "vote", 0.250, skew_corrected=False)
+    tl.record_hop(NODE_B, "proposal", 0.050, skew_corrected=True)
+    stats = tl.peer_stats()
+    # worst origin (by mean over all kinds) first
+    assert list(stats) == [NODE_B, NODE_A]
+    b = stats[NODE_B]
+    assert b["kinds"]["vote"]["count"] == 1
+    assert b["kinds"]["vote"]["mean_ms"] == 250.0
+    assert b["skew_corrected"] == 1 and b["uncorrected"] == 1
+    a = stats[NODE_A]
+    assert a["kinds"]["vote"]["count"] == 4
+    assert a["uncorrected"] == 0
+    tl.clear()
+    assert tl.peer_stats() == {}
+
+
+# ---------------------------------------------------------------------------
+# fleet merge from dump fixtures (offline mode — no net, no RPC)
+
+
+def _slo_snapshot(tripped: bool) -> dict:
+    cfg = SLOConfig(window_fast=10.0, window_slow=100.0, min_samples=3, target=0.9)
+    eng = SLOEngine(cfg)
+    seconds = 5.0 if tripped else 0.01
+    for i in range(6):
+        eng.observe("proposal_propagation", seconds, ts=100.0 + i)
+    return eng.snapshot(now=107.0)
+
+
+def _fixture_dump(node_id, *, t0, recv_lat, commit_off, proposer=None,
+                  tripped=False) -> dict:
+    """One node's observatory dump for heights 10..11, built through the
+    REAL producers (ConsensusTimeline + SLOEngine) so the fixtures cannot
+    drift from capture_node_dump's shape."""
+    tl = ConsensusTimeline()
+    for h in (10, 11):
+        base = t0 + (h - 10) * 1.0
+        tl.record_step(h, 0, "PROPOSE", ts=base)
+        tl.record_proposal(h, 0, ts=base + recv_lat)
+        if proposer is not None:
+            tl.record_proposal_propagation(h, 0, proposer, recv_lat, hops=0, ts=base + recv_lat)
+            tl.record_hop(proposer, "proposal", recv_lat, skew_corrected=True)
+        tl.record_step(h, 0, "PREVOTE", ts=base + recv_lat + 0.01)
+        tl.record_step(h, 0, "PRECOMMIT", ts=base + commit_off * 0.6)
+        tl.record_step(h, 0, "COMMIT", ts=base + commit_off * 0.9)
+        tl.record_commit(h, 0, txs=0, ts=base + commit_off)
+    return {
+        "observatory_dump": obs.DUMP_VERSION,
+        "node_id": node_id,
+        "moniker": f"n-{node_id[:4]}",
+        "timeline": {
+            "heights": tl.dump(),
+            "propagation_peers": tl.peer_stats(),
+        },
+        "slo": _slo_snapshot(tripped),
+    }
+
+
+def _fixture_fleet(tripped=False):
+    # A proposes; B is a fast receiver, C a slow one
+    return [
+        _fixture_dump(NODE_A, t0=200.0, recv_lat=0.0, commit_off=0.50,
+                      tripped=tripped),
+        _fixture_dump(NODE_B, t0=200.0, recv_lat=0.020, commit_off=0.52,
+                      proposer=NODE_A),
+        _fixture_dump(NODE_C, t0=200.0, recv_lat=0.200, commit_off=0.70,
+                      proposer=NODE_A),
+    ]
+
+
+def test_merge_waterfall_proposer_and_slowest_link():
+    report = obs.merge(_fixture_fleet())
+    assert [n["node"] for n in report["nodes"]] == [
+        NODE_A[:10], NODE_B[:10], NODE_C[:10]
+    ]
+    assert len(report["heights"]) == 2
+    h10 = report["heights"][0]
+    assert h10["height"] == 10
+    # the proposer is attributed from the receivers' propagation origin
+    assert h10["proposer"] == NODE_A[:10]
+    rows = h10["nodes"]
+    assert set(rows) == {NODE_A[:10], NODE_B[:10], NODE_C[:10]}
+    # waterfall offsets are ms from the proposer's own proposal record
+    assert rows[NODE_A[:10]]["proposal_recv_ms"] == 0.0
+    assert rows[NODE_B[:10]]["proposal_recv_ms"] == pytest.approx(20.0)
+    assert rows[NODE_C[:10]]["proposal_recv_ms"] == pytest.approx(200.0)
+    assert rows[NODE_C[:10]]["commit_ms"] == pytest.approx(700.0)
+    # every stage of the waterfall is populated for every node
+    for row in rows.values():
+        for key in ("prevote_quorum_ms", "precommit_quorum_ms", "commit_ms"):
+            assert row[key] is not None
+    assert h10["first_peer_receipt_ms"] == pytest.approx(20.0)
+    assert h10["last_peer_receipt_ms"] == pytest.approx(200.0)
+    assert h10["slowest_link"] is not None
+    # peer lag ranking: NODE_A is the only traced origin, observed by B
+    # (20ms proposal hops) and C (200ms), one per height — the merged mean
+    # folds both observers' per-kind aggregates
+    lag = report["peer_lag"][0]
+    assert lag["origin"] == NODE_A[:10]
+    assert lag["observers"] == 2
+    assert lag["msgs"] == 4
+    assert lag["mean_ms"] == pytest.approx(110.0)
+    assert lag["max_ms"] == pytest.approx(200.0)
+    # healthy fleet: no guard tripped
+    assert report["slo_any_tripped"] is False
+    verdicts = {(e["node"], e["objective"]): e for e in report["slo"]}
+    assert verdicts[(NODE_A[:10], "proposal_propagation")]["verdict"] == "ok"
+
+
+def test_merge_flags_tripped_slo_and_render():
+    report = obs.merge(_fixture_fleet(tripped=True))
+    assert report["slo_any_tripped"] is True
+    tripped = [e for e in report["slo"] if e["tripped"]]
+    assert tripped and tripped[0]["node"] == NODE_A[:10]
+    assert tripped[0]["objective"] == "proposal_propagation"
+    md = obs.render_markdown(report)
+    assert "height 10" in md and "height 11" in md
+    assert "ANY GUARD TRIPPED" in md
+    assert "slowest link" in md
+    assert NODE_A[:10] in md
+
+
+def test_cli_offline_merge_and_check_exit_codes(tmp_path, capsys):
+    """main() --dumps: reads observatory_*.json, writes chain_report.{json,md},
+    exit 0 when budgets held, exit 2 under --check with a tripped guard, and
+    a corrupt dump degrades to a load_error row instead of killing the run."""
+    dump_dir = tmp_path / "dumps"
+    dump_dir.mkdir()
+    for i, doc in enumerate(_fixture_fleet()):
+        (dump_dir / f"{obs.DUMP_PREFIX}{i}.json").write_text(json.dumps(doc))
+    out = tmp_path / "report"
+    rc = obs.main(["--dumps", str(dump_dir), "--out", str(out), "--check"])
+    assert rc == 0
+    report = json.loads((out / "chain_report.json").read_text())
+    assert len(report["heights"]) == 2
+    assert (out / "chain_report.md").read_text().startswith("# Chain observatory")
+
+    # tripped fleet + --check -> exit 2; without --check -> exit 0
+    for i, doc in enumerate(_fixture_fleet(tripped=True)):
+        (dump_dir / f"{obs.DUMP_PREFIX}{i}.json").write_text(json.dumps(doc))
+    assert obs.main(["--dumps", str(dump_dir), "--out", str(out), "--check"]) == 2
+    assert obs.main(["--dumps", str(dump_dir), "--out", str(out)]) == 0
+
+    # corrupt dump: survives as a load_error node row
+    (dump_dir / f"{obs.DUMP_PREFIX}zz.json").write_text("{not json")
+    assert obs.main(["--dumps", str(dump_dir), "--out", str(out)]) == 0
+    report = json.loads((out / "chain_report.json").read_text())
+    assert any(n.get("load_error") for n in report["nodes"])
+
+    # empty dir: explicit failure, not an empty report
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert obs.main(["--dumps", str(empty), "--out", str(out)]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a live 4-node net -> dumps -> one merged report
+
+
+def test_observatory_e2e_4node_net(tmp_path):
+    """The acceptance pipeline at tier-1 scale: a 4-validator plaintext net
+    commits a few heights with trace stamps riding every gossiped message;
+    each node's dump is captured in-process and merged into one report whose
+    waterfall covers ALL nodes, with real propagation evidence and passing
+    SLO verdicts — then injected over-budget propagation latency trips one
+    node's guard and --check turns red."""
+    from tests.test_chaos import make_plain_net, _wait_heights
+
+    async def run():
+        make_node = make_plain_net(4, tmp_path, chain="observatory-e2e")
+        nodes = [make_node(i) for i in range(4)]
+        for n in nodes:
+            await n.start()
+        try:
+            for a in nodes:
+                for b in nodes:
+                    if a is not b and not a.switch.peers.has(b.node_key.id):
+                        await a.switch.dial_peers_async(
+                            [f"{b.node_key.id}@{b.p2p_addr}"], persistent=True
+                        )
+
+            class _NetView:
+                def live_nodes(self):
+                    return nodes
+
+            await _wait_heights(
+                _NetView(),
+                lambda: all(n.block_store.height >= 3 for n in nodes),
+            )
+            dump_dir = tmp_path / "observatory"
+            for n in nodes:
+                obs.write_node_dump(n, str(dump_dir))
+        finally:
+            for n in nodes:
+                await n.stop()
+        return nodes
+
+    nodes = asyncio.run(run())
+    labels = {n.node_key.id[:10] for n in nodes}
+
+    dump_dir = str(tmp_path / "observatory")
+    dumps = obs.load_dumps(dump_dir)
+    assert len(dumps) == 4
+    report = obs.merge(dumps)
+    assert not report["slo_any_tripped"], report["slo"]
+
+    # the waterfall covers all 4 nodes on at least one committed height
+    covered = [
+        rec for rec in report["heights"]
+        if set(rec["nodes"]) == labels
+        and all(r["commit_ms"] is not None for r in rec["nodes"].values())
+    ]
+    assert covered, f"no height covered all nodes: {report['heights']}"
+    rec = covered[-1]
+    assert rec["proposer"] in labels
+    # non-proposers saw the proposal through gossip: real propagation
+    # evidence (first-seen latency + hop count) reached the merge
+    traced = [
+        r for label, r in rec["nodes"].items()
+        if r["proposal_first_seen_ms"] is not None
+    ]
+    assert traced, rec
+    assert all(r["proposal_hops"] is not None for r in traced)
+    # per-origin vote/hop aggregates merged from every observer
+    assert report["peer_lag"], "no propagation aggregates reached the report"
+    assert {e["origin"] for e in report["peer_lag"]} <= labels | {"?"}
+
+    # every node held its declared budgets on the clean run
+    assert all(not e["tripped"] for e in report["slo"])
+
+    # inject over-budget propagation latency into node0's engine (the
+    # burn-rate guard proof against a REAL engine fed by this run), re-dump,
+    # re-merge: the report flags it and --check exits 2
+    victim = nodes[0]
+    for _ in range(max(victim.slo.min_samples, 8)):
+        victim.slo.observe("proposal_propagation", 99.0)
+    assert victim.slo.evaluate()["proposal_propagation"]["tripped"]
+    obs.write_node_dump(victim, dump_dir)
+    rc = obs.main([
+        "--dumps", dump_dir, "--out", str(tmp_path / "report"), "--check",
+    ])
+    assert rc == 2
+    merged = json.loads(
+        (tmp_path / "report" / "chain_report.json").read_text()
+    )
+    assert merged["slo_any_tripped"] is True

@@ -108,6 +108,31 @@ def test_chaos_soak_partitions_crashes_equivocator(tmp_path):
             for ev in evs:
                 assert ev.vote_a.height == ev.vote_b.height
                 assert ev.vote_a.validator_address == ev.vote_b.validator_address
+
+            # chain observatory (ISSUE 8 acceptance): the soak emits a merged
+            # fleet report whose proposal->commit waterfall covers ALL nodes
+            # on at least one post-heal height
+            from tendermint_tpu.tools import chain_observatory as obs
+
+            dump_dir = str(tmp_path / "observatory")
+            for n in net.live_nodes():
+                obs.write_node_dump(n, dump_dir)
+            report = obs.merge(obs.load_dumps(dump_dir))
+            labels = {n.node_key.id[:10] for n in net.live_nodes()}
+            covered = [
+                rec for rec in report["heights"]
+                if labels <= set(rec["nodes"])
+                and all(rec["nodes"][l]["commit_ms"] is not None for l in labels)
+            ]
+            assert covered, (
+                f"no height's waterfall covered all {len(labels)} nodes: "
+                f"{[(r['height'], sorted(r['nodes'])) for r in report['heights']]}"
+            )
+            # real cross-node propagation evidence reached the merge
+            assert report["peer_lag"], "no propagation aggregates in the report"
+            (tmp_path / "observatory" / "chain_report.md").write_text(
+                obs.render_markdown(report)
+            )
         finally:
             await net.stop()
 

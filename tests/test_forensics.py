@@ -71,6 +71,34 @@ def test_module_beat_is_noop_until_configured(tmp_path):
     assert F.Heartbeat.read(path)[-1]["phase"] == "rlc_submit"
 
 
+def test_configure_sweeps_stale_heartbeats_from_dead_pids(tmp_path):
+    """Node start must not leave one heartbeat corpse per crashed pid
+    (ISSUE 8 satellite): configure() sweeps rings whose pid is dead, keeps
+    OUR ring and any live process's, ignores non-heartbeat files."""
+    import subprocess
+
+    # a pid that is certainly dead: a waited-on child (not yet recycled)
+    child = subprocess.Popen(["true"])
+    child.wait()
+    dead = tmp_path / f"heartbeat_{child.pid}.bin"
+    dead.write_bytes(b"stale ring")
+    mine = tmp_path / f"heartbeat_{os.getpid()}.bin"
+    mine.write_bytes(b"live ring")
+    bystander = tmp_path / "not_a_heartbeat.bin"
+    bystander.write_bytes(b"keep me")
+
+    removed = F.sweep_stale_heartbeats(str(tmp_path))
+    assert str(dead) in removed
+    assert not dead.exists()
+    assert mine.exists() and bystander.exists()
+
+    # configure() sweeps too (the node-start path) and creates our ring
+    dead.write_bytes(b"stale again")
+    path = F.configure(str(tmp_path))
+    assert not dead.exists()
+    assert os.path.exists(path)
+
+
 def test_capture_names_wedged_phase(tmp_path):
     F.configure(str(tmp_path))
     F.beat("rlc_submit")

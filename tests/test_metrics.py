@@ -36,6 +36,27 @@ def test_registry_exposition_format():
     assert "# TYPE tm_lat histogram" in text
 
 
+def test_gauge_replace_series_drops_departed_members():
+    """replace_series (per-peer sampled gauges, e.g. clock skew): each pass
+    replaces the whole labeled series set, so a departed member's series
+    disappears instead of exposing a stale value forever."""
+    import pytest
+
+    reg = Registry()
+    g = reg.gauge("tm_member_skew", "Skew.", ("peer",))
+    g.replace_series({("a",): 0.5, ("b",): -0.25})
+    text = reg.expose()
+    assert 'tm_member_skew{peer="a"} 0.5' in text
+    assert 'tm_member_skew{peer="b"} -0.25' in text
+    # next sampling pass: b is gone
+    g.replace_series({("a",): 0.75})
+    text = reg.expose()
+    assert 'tm_member_skew{peer="a"} 0.75' in text
+    assert 'peer="b"' not in text
+    with pytest.raises(ValueError):
+        g.replace_series({("a", "extra"): 1.0})
+
+
 def test_node_metrics_populated_and_served(tmp_path):
     """A running node populates consensus/mempool metrics and serves
     /metrics over HTTP when instrumentation is on."""

@@ -63,6 +63,11 @@ def make_overload_net(tmp_path):
         cfg.mempool.ttl_num_blocks = 8
         cfg.mempool.max_txs_per_sender = 60
         cfg.overload.sample_interval = 0.1
+        # SLO policy for the soak (ISSUE 8): a 10% error budget — the guard
+        # trips on a SUSTAINED fraction (>=40% at burn 4x) of over-budget
+        # blocks, not on scattered outliers; the commit-interval budget
+        # itself is declared at runtime from the measured baseline
+        cfg.slo.target = 0.9
         cfg.root_dir = ""
         cfg.consensus.wal_path = str(tmp_path / f"wal{i}" / "wal")
         priv = FilePV(
@@ -121,6 +126,14 @@ def test_overload_soak_flood_shed_recover(tmp_path):
             h0, t0 = victim.block_store.height, loop.time()
             await _wait_height(victim, h0 + 6, deadline, "baseline")
             baseline = (loop.time() - t0) / 6
+
+            # declare the soak's commit-interval budget from the measured
+            # baseline (ISSUE 8: the soak asserts SLOs instead of an ad-hoc
+            # interval ratio — same 2x bound, now burn-rate evaluated: a
+            # trip means a sustained fraction of blocks blew the budget,
+            # one slow block alone cannot fail the soak)
+            assert victim.slo is not None
+            victim.slo.budgets["commit_interval"] = 2 * baseline + 0.25
 
             # ---- flood phase ------------------------------------------
             async def flood():
@@ -182,9 +195,12 @@ def test_overload_soak_flood_shed_recover(tmp_path):
             stop_flood.set()
             await flood_task
 
-            # liveness: block production survived the flood
-            assert flood_interval <= 2 * baseline + 0.25, (
-                f"block interval degraded too far: {flood_interval:.3f}s vs "
+            # liveness: block production survived the flood — the declared
+            # commit-interval budget held (libs/slo.py burn-rate guard; the
+            # measured mean rides the failure message for triage)
+            victim.slo.assert_budgets(["commit_interval"])
+            assert flood_interval <= 3 * baseline + 0.5, (
+                f"block interval collapsed: {flood_interval:.3f}s vs "
                 f"baseline {baseline:.3f}s"
             )
             # the RPC burst was actually served/shed, not lost
@@ -227,6 +243,35 @@ def test_overload_soak_flood_shed_recover(tmp_path):
             await _wait_height(victim, h2 + 3, deadline, "post-flood liveness")
 
             assert_safety(nodes)
+
+            # chain observatory (ISSUE 8 acceptance): merge every node's
+            # dump into the fleet report — the waterfall must cover all
+            # nodes on at least one height, and the victim's declared
+            # commit-interval budget verdict rides the SLO section
+            from tendermint_tpu.tools import chain_observatory as obs
+
+            dump_dir = str(tmp_path / "observatory")
+            for n in nodes:
+                obs.write_node_dump(n, dump_dir)
+            report = obs.merge(obs.load_dumps(dump_dir))
+            labels = {n.node_key.id[:10] for n in nodes}
+            covered = [
+                rec for rec in report["heights"]
+                if labels <= set(rec["nodes"])
+                and all(rec["nodes"][l]["commit_ms"] is not None for l in labels)
+            ]
+            assert covered, (
+                f"no height's waterfall covered all {len(labels)} nodes: "
+                f"{[(r['height'], sorted(r['nodes'])) for r in report['heights']]}"
+            )
+            assert report["peer_lag"], "no propagation aggregates in the report"
+            assert any(
+                e["objective"] == "commit_interval" and not e["tripped"]
+                for e in report["slo"]
+            )
+            (tmp_path / "observatory" / "chain_report.md").write_text(
+                obs.render_markdown(report)
+            )
         finally:
             stop_flood.set()
             for n in nodes:

@@ -1,0 +1,221 @@
+"""SLO engine (libs/slo.py): budget classification, multi-window burn-rate
+trips and re-arms, metrics wiring, and the process-global flush feed.
+
+The guard proof the acceptance criteria require lives here (tier-1, no net
+needed): injected over-budget propagation latency trips the burn-rate guard
+in both windows, and the guard re-arms once the fast window drains. Clocks
+are synthetic — observations and evaluation take explicit timestamps."""
+
+import os
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.config.config import SLOConfig
+from tendermint_tpu.libs import metrics as M
+from tendermint_tpu.libs import slo as slo_mod
+from tendermint_tpu.libs.slo import OBJECTIVES, SLOEngine
+
+
+def make_engine(**overrides):
+    cfg = SLOConfig(
+        target=0.9,  # 10% error budget: burn math stays integral in tests
+        window_fast=10.0,
+        window_slow=100.0,
+        burn_rate_trip=4.0,
+        min_samples=5,
+        proposal_propagation=0.1,
+        prevote_quorum_delay=0.5,
+        commit_interval=1.0,
+        verify_flush_wall=0.2,
+    )
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    reg = M.Registry()
+    return SLOEngine(cfg, metrics=M.SLOMetrics(reg)), reg
+
+
+def test_observe_classifies_against_budget():
+    eng, _ = make_engine()
+    assert eng.observe("proposal_propagation", 0.05, ts=1.0) is True
+    assert eng.observe("proposal_propagation", 0.5, ts=1.1) is False
+    snap = eng.snapshot(now=2.0)
+    obj = snap["objectives"]["proposal_propagation"]
+    assert obj["observations"] == 2
+    assert obj["breaches"] == 1
+    assert obj["worst_s"] == 0.5
+    assert obj["budget_s"] == 0.1
+    # unknown objectives are ignored, never raise (feeder safety)
+    assert eng.observe("no_such_objective", 99.0) is True
+
+
+def test_burn_rate_trips_on_injected_latency_and_rearms():
+    """THE guard proof: a healthy stream keeps burn at 0; injected
+    over-budget latency pushes burn past the trip threshold in BOTH windows;
+    once the bad samples age out of the fast window the guard re-arms."""
+    eng, _ = make_engine()
+    t = 1000.0
+    # healthy phase: 20 good observations over 20s
+    for i in range(20):
+        eng.observe("proposal_propagation", 0.01, ts=t + i)
+    ev = eng.evaluate(now=t + 20)
+    obj = ev["proposal_propagation"]
+    assert obj["verdict"] == "ok" and not obj["tripped"]
+    assert obj["burn_rate"]["fast"]["burn"] == 0.0
+
+    # injected latency: every proposal now blows the 100ms budget. The slow
+    # window still holds the 20 goods, so the breach count must push
+    # (bad/total)/0.1 past 4.0 there too: 15/(20+15) = 0.43 -> burn 4.3
+    t2 = t + 20
+    for i in range(15):
+        eng.observe("proposal_propagation", 0.8, ts=t2 + i * 0.5)
+    ev = eng.evaluate(now=t2 + 8)
+    obj = ev["proposal_propagation"]
+    # fast window (10s) holds almost only breaches: burn ~= 1.0/0.1 = 10 >= 4
+    assert obj["burn_rate"]["fast"]["burn"] >= 4.0
+    assert obj["burn_rate"]["slow"]["burn"] >= 4.0
+    assert obj["tripped"] and obj["verdict"] == "tripped"
+    assert obj["trips_total"] == 1
+    assert eng.any_tripped()
+    with pytest.raises(AssertionError, match="proposal_propagation"):
+        eng.assert_budgets()
+
+    # recovery: good traffic again; once the fast window no longer burns
+    # past the threshold the guard re-arms (trips_total stays 1)
+    t3 = t2 + 8
+    for i in range(40):
+        eng.observe("proposal_propagation", 0.01, ts=t3 + i * 0.5)
+    ev = eng.evaluate(now=t3 + 25)
+    obj = ev["proposal_propagation"]
+    assert not obj["tripped"]
+    assert obj["trips_total"] == 1
+    assert not eng.any_tripped()
+
+
+def test_min_samples_guards_idle_chains():
+    """One slow block on an idle chain must not page: below min_samples in
+    the fast window the guard cannot trip even at infinite burn."""
+    eng, _ = make_engine(min_samples=5)
+    for i in range(4):
+        eng.observe("commit_interval", 5.0, ts=100.0 + i)
+    obj = eng.evaluate(now=105.0)["commit_interval"]
+    assert obj["burn_rate"]["fast"]["burn"] >= 4.0
+    assert not obj["tripped"]
+    # the fifth breach crosses min_samples: now it trips
+    eng.observe("commit_interval", 5.0, ts=104.5)
+    assert eng.evaluate(now=105.0)["commit_interval"]["tripped"]
+
+
+def test_trip_requires_both_windows():
+    """A burst that saturates the fast window but is diluted over the slow
+    window must NOT trip (single-window flap protection): 6 breaches in the
+    last 10s against 300 goods spread over 100s."""
+    eng, _ = make_engine()
+    t = 0.0
+    for i in range(300):
+        eng.observe("verify_flush_wall", 0.01, ts=t + i * 0.3)  # 90s of good
+    t2 = 91.0
+    for i in range(6):
+        eng.observe("verify_flush_wall", 1.0, ts=t2 + i)
+    # evaluate with the goods aged OUT of the fast window (they end at 89.7,
+    # cutoff is 90): fast burn is pure breach, slow burn is diluted
+    obj = eng.evaluate(now=t2 + 9)["verify_flush_wall"]
+    assert obj["burn_rate"]["fast"]["burn"] >= 4.0
+    assert obj["burn_rate"]["slow"]["burn"] < 4.0
+    assert not obj["tripped"]
+
+
+def test_metrics_written():
+    eng, reg = make_engine()
+    for i in range(6):
+        eng.observe("prevote_quorum_delay", 2.0, ts=50.0 + i)
+    eng.evaluate(now=56.0)
+    text = reg.expose()
+    assert 'tendermint_slo_observations_total{slo="prevote_quorum_delay", verdict="breach"} 6' in text
+    assert 'tendermint_slo_tripped{slo="prevote_quorum_delay"} 1' in text
+    assert 'tendermint_slo_trips_total{slo="prevote_quorum_delay"} 1' in text
+    assert 'tendermint_slo_budget_seconds{slo="prevote_quorum_delay"} 0.5' in text
+    assert 'tendermint_slo_burn_rate{slo="prevote_quorum_delay", window="fast"}' in text
+
+
+def test_flush_feed_routes_to_default_engine():
+    """libs/trace.record_flush feeds verify_flush_wall through the
+    process-global default engine (last node wins, tracer model)."""
+    from tendermint_tpu.libs import trace
+
+    eng, _ = make_engine()
+    old = slo_mod.default_engine()
+    slo_mod.set_default(eng)
+    try:
+        trace.record_flush(backend="cpu", path="test-slo", n=4, total_s=0.9)
+        trace.record_flush(backend="cpu", path="test-slo", n=4, total_s=0.01)
+    finally:
+        slo_mod.set_default(old)
+    snap = eng.snapshot()
+    obj = snap["objectives"]["verify_flush_wall"]
+    assert obj["observations"] == 2
+    assert obj["breaches"] == 1  # 0.9s > 0.2s budget
+
+
+def test_snapshot_shape_and_objectives_catalog():
+    eng, _ = make_engine()
+    snap = eng.snapshot(now=1.0)
+    assert snap["enabled"] is True
+    assert set(snap["objectives"]) == set(OBJECTIVES)
+    for obj in snap["objectives"].values():
+        assert {"budget_s", "burn_rate", "tripped", "verdict"} <= set(obj)
+        assert {"fast", "slow"} == set(obj["burn_rate"])
+
+
+def test_node_wires_engine_and_debug_slo_route(tmp_path):
+    """A Node constructs the engine from [slo], the RPC layer serves
+    /debug/slo and the /debug index lists every endpoint."""
+    import asyncio
+
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import gen_ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.rpc.client import LocalClient
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    async def run():
+        cfg = test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = ""
+        cfg.root_dir = ""
+        cfg.consensus.wal_path = str(tmp_path / "wal" / "wal")
+        cfg.instrumentation.forensics_dir = str(tmp_path / "forensics")
+        priv = FilePV(gen_ed25519(b"s" * 32), state_file=str(tmp_path / "pv.json"))
+        gen = GenesisDoc(
+            chain_id="slo-route",
+            validators=[GenesisValidator(priv.get_pub_key(), 10)],
+        )
+        node = Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
+        assert node.slo is not None
+        assert node.consensus.slo is node.slo
+        await node.start()
+        try:
+            await node.wait_for_height(2)
+            client = LocalClient(node)
+            snap = await client.call("debug_slo")
+            assert snap["enabled"] is True
+            ci = snap["objectives"]["commit_interval"]
+            assert ci["observations"] >= 1
+            # a healthy single-node test chain must hold its budgets
+            assert not snap["any_tripped"]
+            idx = await client.call("debug_index")
+            paths = {e["path"] for e in idx["endpoints"]}
+            assert {
+                "/debug", "/debug/trace", "/debug/verify_stats",
+                "/debug/consensus_timeline", "/debug/overload",
+                "/debug/mesh", "/debug/slo", "/debug/device_profile",
+                "/metrics",
+            } <= paths
+            assert all(e["description"] for e in idx["endpoints"])
+        finally:
+            await node.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Standalone runner for the chain observatory (fleet aggregation).
+
+Scrapes every node's debug surfaces (live over RPC with --nodes, or offline
+from observatory_*.json dumps with --dumps) and merges them into one
+markdown + JSON chain report: per-height proposal→commit waterfall,
+slowest-link attribution, per-peer lag ranking, SLO verdicts. The
+implementation lives in tendermint_tpu/tools/chain_observatory.py. Usage:
+
+    python tools/chain_observatory.py --nodes http://127.0.0.1:26657,...
+    python tools/chain_observatory.py --dumps ./observatory [--check]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from tendermint_tpu.tools.chain_observatory import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
