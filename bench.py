@@ -44,6 +44,15 @@ Sub-benchmarks (in "extra", budget permitting):
                         overload controller's pressure snapshot, and
                         block_interval_ratio (flooded vs unloaded — the
                         acceptance bound is <= 2x)
+  light_serve         — light-client-as-a-service (docs/LIGHT.md): N
+                        concurrent clients issue Zipfian-height
+                        skipping-verification requests against a
+                        LightService; reports sustained
+                        client_verifs_per_sec, p50/p99 request latency,
+                        device_flushes (coalesced cross-height windows),
+                        cache/single-flight hit counts, and speedup =
+                        serial per-request verification cost / coalesced
+                        per-request cost
 
 Scenario isolation (round 7): every scenario runs in its OWN subprocess
 with a per-stage watchdog inside and a hard process-group deadline outside.
@@ -1026,6 +1035,181 @@ def bench_overload():
     }
 
 
+def make_light_chain(heights: int, n_vals: int, chain_id: str = "bench-light"):
+    """`heights` signed light blocks with correct hash/valset chaining
+    (constant validator set — the scenario measures the serving layer's
+    coalescing, not bisection). Returns (blocks, now_ns, period_ns)."""
+    from tendermint_tpu.crypto import tmhash
+    from tendermint_tpu.crypto.keys import gen_ed25519
+    from tendermint_tpu.types.basic import (
+        NANOS,
+        BlockID,
+        BlockIDFlag,
+        PartSetHeader,
+    )
+    from tendermint_tpu.types.block import (
+        Commit,
+        CommitSig,
+        ConsensusVersion,
+        Header,
+    )
+    from tendermint_tpu.types.light import LightBlock, SignedHeader
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+
+    privs = [
+        gen_ed25519(bytes([i % 256, i // 256]) + b"\x5a" * 30)
+        for i in range(n_vals)
+    ]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    t0 = 1_700_000_000 * NANOS
+    blocks = {}
+    prev_hash = b""
+    for h in range(1, heights + 1):
+        header = Header(
+            version=ConsensusVersion(),
+            chain_id=chain_id,
+            height=h,
+            time_ns=t0 + h * NANOS,
+            last_block_id=(
+                BlockID(prev_hash, PartSetHeader(1, tmhash.sum256(prev_hash)))
+                if prev_hash
+                else BlockID()
+            ),
+            last_commit_hash=tmhash.sum256(b"lc%d" % h),
+            data_hash=tmhash.sum256(b"d%d" % h),
+            validators_hash=vals.hash(),
+            next_validators_hash=vals.hash(),
+            consensus_hash=tmhash.sum256(b"c"),
+            app_hash=tmhash.sum256(b"a%d" % h),
+            last_results_hash=tmhash.sum256(b"r%d" % h),
+            evidence_hash=tmhash.sum256(b"e"),
+            proposer_address=vals.get_proposer().address,
+        )
+        block_id = BlockID(header.hash(), PartSetHeader(1, tmhash.sum256(header.hash())))
+        placeholder = [
+            CommitSig(BlockIDFlag.COMMIT, v.address, header.time_ns, b"\x00" * 64)
+            for v in vals.validators
+        ]
+        commit = Commit(h, 0, block_id, placeholder)
+        sigs = []
+        for idx, v in enumerate(vals.validators):
+            sb = commit.vote_sign_bytes(chain_id, idx)
+            sigs.append(
+                CommitSig(
+                    BlockIDFlag.COMMIT, v.address, header.time_ns,
+                    by_addr[v.address].sign(sb),
+                )
+            )
+        blocks[h] = LightBlock(SignedHeader(header, Commit(h, 0, block_id, sigs)), vals)
+        prev_hash = header.hash()
+    now_ns = t0 + (heights + 3600) * NANOS
+    return blocks, now_ns, 7 * 24 * 3600 * NANOS
+
+
+def bench_light_serve(
+    heights: int = 24,
+    n_vals: int = 32,
+    clients: int = 32,
+    requests: int = 600,
+    window: float = 0.02,
+    seed: int = 7,
+):
+    """Light-client-as-a-service scenario (docs/LIGHT.md, ROADMAP item 3):
+    N concurrent clients issue `requests` skipping-verification requests
+    with Zipfian height popularity against a LightService over a synthetic
+    signed chain. Reports sustained client-verifications/s, per-request
+    p50/p99 latency, and the coalesced-vs-serial speedup — serial = each
+    request running its OWN verify_non_adjacent (no cache, no shared
+    flushes), which is what answering every client individually costs.
+    Host-side by construction on CPU backends; on a device backend the
+    coalesced flush is the same verify_batch pipeline the consensus path
+    uses."""
+    import asyncio
+    import random
+
+    from tendermint_tpu.config.config import LightServiceConfig
+    from tendermint_tpu.light import verifier as light_verifier
+    from tendermint_tpu.light.provider import MockProvider
+    from tendermint_tpu.light.service import LightService
+    from tendermint_tpu.types.basic import NANOS
+
+    chain_id = "bench-light"
+    log(f"[light_serve] building {heights}x{n_vals} signed chain...")
+    blocks, now_ns, period_ns = make_light_chain(heights, n_vals, chain_id)
+    drift_ns = 10 * NANOS
+
+    rng = random.Random(seed)
+    ranks = list(range(2, heights + 1))
+    weights = [1.0 / (i + 1) ** 1.1 for i in range(len(ranks))]
+    reqs = rng.choices(ranks, weights, k=requests)
+
+    # serial baseline: per-request skipping verification from the anchor,
+    # sampled and extrapolated (it is exactly linear in requests)
+    anchor = blocks[1]
+    sample = reqs[: min(len(reqs), 60)]
+    t0 = time.perf_counter()
+    for h in sample:
+        light_verifier.verify(
+            chain_id, anchor.signed_header, anchor.validator_set,
+            blocks[h].signed_header, blocks[h].validator_set,
+            period_ns, now_ns, drift_ns,
+        )
+    serial_per_req = (time.perf_counter() - t0) / len(sample)
+
+    svc = LightService(
+        chain_id,
+        MockProvider(chain_id, blocks),
+        LightServiceConfig(
+            coalesce_window=window,
+            max_heights_per_flush=heights + 1,
+            max_pending=0,  # the bench measures throughput, not shedding
+        ),
+        now_ns=lambda: now_ns,
+    )
+    lats: list = []
+
+    async def client_task(my_reqs):
+        for h in my_reqs:
+            t1 = time.perf_counter()
+            await svc.verify_height(h)
+            lats.append(time.perf_counter() - t1)
+
+    async def run():
+        chunks = [reqs[i::clients] for i in range(clients)]
+        t1 = time.perf_counter()
+        await asyncio.gather(*[client_task(c) for c in chunks if c])
+        return time.perf_counter() - t1
+
+    wall = asyncio.run(run())
+    svc.close()
+    lats.sort()
+
+    def pct(p):
+        return round(lats[min(len(lats) - 1, int(p * len(lats)))] * 1e3, 3)
+
+    stats = svc.stats()
+    coalesced_per_req = wall / len(reqs)
+    return {
+        "heights": heights,
+        "validators": n_vals,
+        "clients": clients,
+        "requests": len(reqs),
+        "zipf_exponent": 1.1,
+        "seed": seed,
+        "client_verifs_per_sec": round(len(reqs) / wall),
+        "latency_ms": {"p50": pct(0.50), "p99": pct(0.99)},
+        "serial_per_req_ms": round(serial_per_req * 1e3, 3),
+        "coalesced_per_req_ms": round(coalesced_per_req * 1e3, 3),
+        "speedup": round(serial_per_req / coalesced_per_req, 2),
+        "device_flushes": stats["flushes"],
+        "coalesced_lanes_total": stats["lanes_total"],
+        "cache_hits": stats["cache_hits"],
+        "singleflight_waits": stats["singleflight_waits"],
+        "windows_fired": stats["coalescer"]["windows_fired"],
+    }
+
+
 @contextlib.contextmanager
 def watchdog(seconds: float):
     """Abort a stage if it stalls: the device tunnel has been observed to
@@ -1107,6 +1291,7 @@ _SCENARIO_PLAN = [
     ("vote_storm", 120.0, 400.0),
     ("chaos_recovery", 90.0, 300.0),
     ("overload", 90.0, 400.0),
+    ("light_serve", 60.0, 300.0),
     ("live_consensus", 240.0, 500.0),
 ]
 
@@ -1138,6 +1323,7 @@ def _scenario_fns() -> dict:
     fns["vote_storm"] = bench_vote_storm
     fns["chaos_recovery"] = bench_chaos_recovery
     fns["overload"] = bench_overload
+    fns["light_serve"] = bench_light_serve
     fns["live_consensus"] = bench_live_consensus
     # harness self-test scenarios (tests/test_bench_guard.py): cheap,
     # host-only, never in the default plan
@@ -1179,6 +1365,9 @@ def _cpu_fallback_fns() -> dict:
     # host-side scenarios run their real body on the CPU backend
     fns["vote_storm"] = lambda: bench_vote_storm(n_vals=256, heights=2)
     fns["overload"] = bench_overload
+    fns["light_serve"] = lambda: bench_light_serve(
+        heights=8, n_vals=8, clients=8, requests=120
+    )
     return fns
 
 

@@ -229,6 +229,42 @@ class SLOConfig:
     commit_interval: float = 15.0
     # one batch-verify flush, any backend
     verify_flush_wall: float = 2.0
+    # one light_verify request, admission -> verified response (the serving
+    # subsystem's p99 budget; fed by light/service.py per request)
+    light_verify_p99: float = 0.5
+
+
+@dataclass
+class LightServiceConfig:
+    """Light-client-as-a-service (light/service.py; no reference
+    counterpart — the reference's `tendermint light` is a client-side
+    proxy, not a serving subsystem). The node answers skipping-verification
+    requests for thousands of clients: repeat heights hit a bounded
+    verified-header cache (single-flight), distinct-height misses coalesce
+    into shared cross-height device flushes, and admission rides the PR 5
+    LoadGate so the live vote path is never starved."""
+
+    enabled: bool = True
+    # coalescing window (seconds): the first cache miss arms the window;
+    # every miss arriving within it joins ONE shared device flush. 0 still
+    # coalesces same-event-loop-tick bursts.
+    coalesce_window: float = 0.01
+    # window capacity: a window flushes early once this many distinct
+    # heights joined (bounds worst-case lanes per flush)
+    max_heights_per_flush: int = 64
+    # verified-header cache bound (LightStore pruning size)
+    cache_blocks: int = 2048
+    # service-level admission backstop: misses in flight past this shed
+    # with 429 + Retry-After (cache hits are never shed). 0 disables.
+    max_pending: int = 1024
+    # trusting period (seconds) for the service's anchor span; a trusted
+    # ancestor older than this routes through the bisection client
+    trust_period: float = 7 * 24 * 3600.0
+    # skipping-verification trust level (reference DefaultTrustLevel 1/3)
+    trust_level_numerator: int = 1
+    trust_level_denominator: int = 3
+    # clock drift tolerance (seconds) for header time checks
+    max_clock_drift: float = 10.0
 
 
 @dataclass
@@ -338,6 +374,7 @@ class Config:
     fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
     overload: OverloadConfig = field(default_factory=OverloadConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
+    light_service: LightServiceConfig = field(default_factory=LightServiceConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)

@@ -29,8 +29,10 @@ through this module.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import os
+import threading
 import time
 from typing import List, Optional, Sequence
 
@@ -1123,15 +1125,119 @@ def _verify_batch_mixed_exact(
     return out
 
 
+class FlushAccumulator:
+    """Cross-request flush accumulation (light/service.py): while installed
+    on this thread via `accumulate_flushes()`, every `verify_batch_submit`
+    appends its (pubkey, msg, sig) rows here instead of dispatching its own
+    device call, and `flush()` verifies ALL accumulated rows as ONE batch —
+    many independent commit verifications (many clients x many heights)
+    share a single device flush. Each submit's `verify_batch_finish`
+    returns its own contiguous slice of the combined mask.
+
+    Verdicts are byte-identical to per-request verification: the combined
+    RLC check only short-circuits when EVERY row is valid, and any failure
+    recovers the exact per-row mask (verify_batch's fallback ladder), so a
+    bad signature in one client's commit never changes another client's
+    verdict."""
+
+    __slots__ = ("backend", "pubkeys", "msgs", "sigs", "key_types",
+                 "_mask", "_flushed", "_error", "flush_count")
+
+    def __init__(self, backend: Optional[str] = None):
+        self.backend = backend
+        self.pubkeys: list = []
+        self.msgs: list = []
+        self.sigs: list = []
+        self.key_types: list = []
+        self._mask: Optional[np.ndarray] = None
+        self._flushed = False
+        self._error: Optional[BaseException] = None
+        self.flush_count = 0  # device flushes this accumulator issued
+
+    @property
+    def lanes(self) -> int:
+        return len(self.pubkeys)
+
+    def add(self, pubkeys, msgs, sigs, key_types) -> tuple:
+        """Append one submit's rows; returns its (start, end) slice."""
+        if self._flushed:
+            raise RuntimeError("FlushAccumulator already flushed")
+        start = len(self.pubkeys)
+        self.pubkeys.extend(pubkeys)
+        self.msgs.extend(msgs)
+        self.sigs.extend(sigs)
+        self.key_types.extend(
+            key_types if key_types is not None else ["ed25519"] * len(pubkeys)
+        )
+        return start, len(self.pubkeys)
+
+    def flush(self) -> np.ndarray:
+        """Verify every accumulated row in one batch (idempotent — a failed
+        flush latches its error and re-raises it for every later finish,
+        rather than retrying the device or returning None). Must be called
+        OUTSIDE the accumulate_flushes() scope or on an accumulator no
+        longer installed — verify_batch itself routes normally."""
+        if self._flushed:
+            if self._error is not None:
+                raise self._error
+            return self._mask
+        self._flushed = True
+        if not self.pubkeys:
+            self._mask = np.zeros(0, dtype=bool)
+            return self._mask
+        kt = (
+            self.key_types
+            if any(t != "ed25519" for t in self.key_types)
+            else None
+        )
+        self.flush_count += 1
+        try:
+            self._mask = verify_batch(
+                self.pubkeys, self.msgs, self.sigs, self.backend, kt
+            )
+        except BaseException as e:
+            self._error = e
+            raise
+        return self._mask
+
+
+_ACC_TLS = threading.local()
+
+
+def current_accumulator() -> Optional[FlushAccumulator]:
+    return getattr(_ACC_TLS, "current", None)
+
+
+@contextlib.contextmanager
+def accumulate_flushes(acc: Optional[FlushAccumulator] = None,
+                       backend: Optional[str] = None):
+    """Install a FlushAccumulator on THIS thread: verify_batch_submit calls
+    inside the scope accumulate instead of dispatching. The scope exit does
+    NOT flush — callers flush explicitly (or lazily via the first
+    verify_batch_finish) so the one device call happens exactly where the
+    coalescing window decides. Thread-local, like nothing else in this
+    module is: the light service runs whole windows inside one worker
+    thread, and an accumulator must never capture an unrelated thread's
+    flushes."""
+    acc = acc or FlushAccumulator(backend=backend)
+    prev = getattr(_ACC_TLS, "current", None)
+    _ACC_TLS.current = acc
+    try:
+        yield acc
+    finally:
+        _ACC_TLS.current = prev
+
+
 class BatchHandle:
     """An in-flight verify_batch: device work submitted, not yet synced.
     Lets independent verification sites (e.g. the light client's
     trusting+light pair, reference light/verifier.go:32) overlap their
     device round trips instead of paying one each, serially."""
 
-    __slots__ = ("_mask", "_call", "_args", "_t0")
+    __slots__ = ("_mask", "_call", "_args", "_t0", "_acc", "_acc_range")
 
-    def __init__(self, mask=None, call=None, args=None, t0=None):
+    def __init__(self, mask=None, call=None, args=None, t0=None,
+                 acc=None, acc_range=None):
         self._mask = mask
         self._call = call
         self._args = args
@@ -1139,6 +1245,10 @@ class BatchHandle:
         # submit THROUGH finish (docs/OBSERVABILITY.md: total = end-to-end),
         # not just the finish-side sync
         self._t0 = t0
+        # cross-request accumulation (FlushAccumulator): finish() slices the
+        # shared mask instead of syncing its own device call
+        self._acc = acc
+        self._acc_range = acc_range
 
 
 def verify_batch_submit(
@@ -1152,6 +1262,13 @@ def verify_batch_submit(
     batches return with device work merely SUBMITTED (JAX async dispatch) so
     multiple submits queue back-to-back on device; anything else computes
     eagerly inside the handle."""
+    acc = current_accumulator()
+    if acc is not None:
+        # cross-request accumulation scope (light/service.py): append the
+        # rows to the shared flush; finish() slices the combined mask
+        return BatchHandle(
+            acc=acc, acc_range=acc.add(pubkeys, msgs, sigs, key_types)
+        )
     be = backend or backend_default()
     mixed = key_types is not None and any(t != "ed25519" for t in key_types)
     eligible = (
@@ -1184,6 +1301,12 @@ def verify_batch_submit(
 
 def verify_batch_finish(h: BatchHandle) -> np.ndarray:
     if h._mask is not None:
+        return h._mask
+    if h._acc is not None:
+        # accumulated submit: the shared flush (lazy if the owner didn't
+        # flush explicitly) already verified every row exactly once
+        start, end = h._acc_range
+        h._mask = h._acc.flush()[start:end]
         return h._mask
     pubkeys, msgs, sigs, backend, key_types, mixed = h._args
     tr = _trace.tracer if _trace.tracer.enabled else None  # single flag check

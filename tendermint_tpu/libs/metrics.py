@@ -790,6 +790,43 @@ class SLOMetrics:
         )
 
 
+class LightServiceMetrics:
+    """Light-client-as-a-service accounting (light/service.py): the
+    tendermint_light_* series a serving fleet's dashboard reads. Node-local
+    (each node runs its own service over its own chain data). No reference
+    counterpart — the reference's light client is client-side only."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_light"
+        self.requests = reg.counter(
+            f"{ns}_requests_total",
+            "Light verification requests by outcome (cache/flush/bisection/"
+            "shed/conflict/error).",
+            ("outcome",),
+        )
+        self.cache_hits = reg.counter(
+            f"{ns}_cache_hits_total",
+            "Requests answered from the verified-header cache (includes "
+            "single-flight followers).",
+        )
+        self.coalesced_lanes = reg.histogram(
+            f"{ns}_coalesced_lanes_per_flush",
+            "Signature lanes accumulated per coalesced cross-height device "
+            "flush (many clients x many heights sharing one flush).",
+            buckets=(1, 8, 64, 256, 1024, 4096, 16384, 65536),
+        )
+        self.shed = reg.counter(
+            f"{ns}_shed_total",
+            "Requests refused by the service-level max_pending backstop "
+            "(the RPC LoadGate's sheds are counted separately).",
+        )
+        self.conflicting_headers = reg.counter(
+            f"{ns}_conflicting_headers_total",
+            "Conflicting-header detections (client-expected hash or a "
+            "second verification path disagreed with the verified header).",
+        )
+
+
 class ChaosMetrics:
     """tendermint_tpu/chaos engine accounting: how many faults a soak/smoke
     injected per level. Exposed so a chaos run's /metrics scrape shows the
@@ -872,6 +909,7 @@ class NodeMetrics:
         self.rpc = RPCMetrics(self.registry)
         self.overload = OverloadMetrics(self.registry)
         self.slo = SLOMetrics(self.registry)
+        self.light = LightServiceMetrics(self.registry)
         NodeMetrics._latest = self
 
     @classmethod

@@ -64,6 +64,11 @@ OBJECTIVES = {
         "verify_flush_wall",
         "wall seconds of one batch-verify flush (any backend)",
     ),
+    "light_verify_p99": (
+        "light_verify_p99",
+        "seconds from a light_verify request's admission to its verified "
+        "response (cache, coalesced flush, or bisection fallback)",
+    ),
 }
 
 # ring bound per objective: at soak rates (~10 obs/s) this covers the slow

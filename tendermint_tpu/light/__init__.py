@@ -20,6 +20,11 @@ from tendermint_tpu.light.provider import (  # noqa: F401
     Provider,
 )
 from tendermint_tpu.light.store import LightStore  # noqa: F401
+
+# The server-side verification service (light/service.py) is imported
+# lazily by its consumers (node, rpc, bench) — not re-exported here — so
+# importing the light CLIENT package never pulls the coalescer/crypto
+# stack into minimal contexts.
 from tendermint_tpu.light.verifier import (  # noqa: F401
     DEFAULT_TRUST_LEVEL,
     ErrInvalidHeader,

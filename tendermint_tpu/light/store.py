@@ -2,12 +2,19 @@
 
 reference: light/store/store.go (Store iface) + light/store/db/db.go
 (DB-backed impl with ordered heights, size-bounded pruning).
-"""
+
+Thread-safe: the light SERVICE (light/service.py) uses a LightStore as its
+verified-header cache and hits it from many concurrent request tasks, the
+coalescer's worker thread, and the pruner at once — `_heights` is guarded
+by an RLock so a reader never sees a half-applied insert/remove (the
+reference wraps its db in a mutex for the same reason,
+light/store/db/db.go:25)."""
 
 from __future__ import annotations
 
 import bisect
 import struct
+import threading
 from typing import List, Optional
 
 from tendermint_tpu.libs.kvdb import KVDB
@@ -30,6 +37,7 @@ class LightStore:
 
     def __init__(self, db: KVDB):
         self.db = db
+        self._lock = threading.RLock()
         self._heights: List[int] = [
             struct.unpack(">Q", k[len(_LB_PREFIX):])[0]
             for k, _ in db.iterate_prefix(_LB_PREFIX)
@@ -40,10 +48,11 @@ class LightStore:
         """reference: light/store/db/db.go:52 SaveLightBlock."""
         if lb.height <= 0:
             raise ValueError("height <= 0")
-        i = bisect.bisect_left(self._heights, lb.height)
-        if i == len(self._heights) or self._heights[i] != lb.height:
-            self._heights.insert(i, lb.height)
-        self.db.set(_key(lb.height), light_block_to_bytes(lb))
+        with self._lock:
+            i = bisect.bisect_left(self._heights, lb.height)
+            if i == len(self._heights) or self._heights[i] != lb.height:
+                self._heights.insert(i, lb.height)
+            self.db.set(_key(lb.height), light_block_to_bytes(lb))
 
     def light_block(self, height: int) -> Optional[LightBlock]:
         """reference: light/store/db/db.go:96 LightBlock."""
@@ -52,33 +61,43 @@ class LightStore:
 
     def latest_light_block(self) -> Optional[LightBlock]:
         """reference: light/store/db/db.go:126 LightBlockBefore/latest."""
-        return self.light_block(self._heights[-1]) if self._heights else None
+        with self._lock:
+            h = self._heights[-1] if self._heights else None
+        return self.light_block(h) if h is not None else None
 
     def first_light_block(self) -> Optional[LightBlock]:
-        return self.light_block(self._heights[0]) if self._heights else None
+        with self._lock:
+            h = self._heights[0] if self._heights else None
+        return self.light_block(h) if h is not None else None
 
     def light_block_before(self, height: int) -> Optional[LightBlock]:
         """Latest stored block strictly below height
         (reference: light/store/db/db.go:126)."""
-        i = bisect.bisect_left(self._heights, height)
-        if i == 0:
-            return None
-        return self.light_block(self._heights[i - 1])
+        with self._lock:
+            i = bisect.bisect_left(self._heights, height)
+            if i == 0:
+                return None
+            h = self._heights[i - 1]
+        return self.light_block(h)
 
     def delete_light_block(self, height: int) -> None:
-        self.db.delete(_key(height))
-        try:
-            self._heights.remove(height)
-        except ValueError:
-            pass
+        with self._lock:
+            self.db.delete(_key(height))
+            try:
+                self._heights.remove(height)
+            except ValueError:
+                pass
 
     def prune(self, size: int) -> None:
         """Keep only the newest `size` blocks (reference: light/store/db/db.go:152)."""
-        while len(self._heights) > size:
-            self.delete_light_block(self._heights[0])
+        with self._lock:
+            while len(self._heights) > size:
+                self.delete_light_block(self._heights[0])
 
     def size(self) -> int:
-        return len(self._heights)
+        with self._lock:
+            return len(self._heights)
 
     def heights(self) -> List[int]:
-        return list(self._heights)
+        with self._lock:
+            return list(self._heights)

@@ -15,6 +15,12 @@ from aiohttp import web, WSMsgType
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto import tmhash
 from tendermint_tpu.libs.pubsub import Query
+from tendermint_tpu.light.service import (
+    ErrBadRequest,
+    ErrLightDisabled,
+    ErrLightOverloaded,
+    LightServiceError,
+)
 from tendermint_tpu.mempool.mempool import MempoolError
 from tendermint_tpu.types.event_bus import EVENT_TX, TX_HASH_KEY, query_for_event
 from tendermint_tpu.types.light import (
@@ -61,6 +67,10 @@ SHEDDABLE_METHODS = frozenset({
     "tx", "tx_search", "block_search",
     "block", "blockchain", "block_results", "block_by_hash", "commit",
     "unconfirmed_txs",
+    # light-client serving (light/service.py): per-client admission rides
+    # this gate (429 + Retry-After) so a light-verification flood can never
+    # starve the live vote path; light_status bypasses like status
+    "light_verify", "light_block",
 })
 # Under overload pressure (node/overload.py flips rpc_shed_writes before
 # rpc_shed_reads), write-path methods shed first.
@@ -131,6 +141,7 @@ class RPCServer:
         self.app.router.add_get("/debug/overload", self._handle_debug_overload)
         self.app.router.add_get("/debug/mesh", self._handle_debug_mesh)
         self.app.router.add_get("/debug/slo", self._handle_debug_slo)
+        self.app.router.add_get("/debug/light", self._handle_debug_light)
         self.app.router.add_get(
             "/debug/device_profile", self._handle_debug_device_profile
         )
@@ -183,6 +194,11 @@ class RPCServer:
             "debug_slo": self._debug_slo,
             "debug_index": self._debug_index,
             "debug_device_profile": self._debug_device_profile,
+            # light-client-as-a-service (light/service.py)
+            "light_verify": self._light_verify,
+            "light_block": self._light_block,
+            "light_status": self._light_status,
+            "debug_light": self._debug_light,
         }
 
     # -- load shedding -------------------------------------------------------
@@ -252,8 +268,12 @@ class RPCServer:
             return web.json_response(_result(id_, result))
         except RPCShedError:
             return self._shed_response(id_, method)
+        except ErrLightOverloaded:
+            return self._shed_response(id_, method)
         except MempoolError as e:
             return web.json_response(self._mempool_reject(id_, e))
+        except LightServiceError as e:
+            return web.json_response(_error(id_, e.code, str(e), e.data))
         except Exception as e:
             logger.exception("rpc error in %s", method)
             return web.json_response(_error(id_, -32603, "internal error", str(e)))
@@ -315,6 +335,12 @@ class RPCServer:
         except Exception as e:
             return web.json_response(_error(None, -32603, "internal error", str(e)))
 
+    async def _handle_debug_light(self, request: web.Request) -> web.Response:
+        try:
+            return web.json_response(_result(None, await self._debug_light({})))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
+
     async def _handle_debug_device_profile(self, request: web.Request) -> web.Response:
         params = {k: v for k, v in request.query.items()}
         try:
@@ -335,8 +361,12 @@ class RPCServer:
             return web.json_response(_result(None, result))
         except RPCShedError:
             return self._shed_response(None, method)
+        except ErrLightOverloaded:
+            return self._shed_response(None, method)
         except MempoolError as e:
             return web.json_response(self._mempool_reject(None, e))
+        except LightServiceError as e:
+            return web.json_response(_error(None, e.code, str(e), e.data))
         except Exception as e:
             return web.json_response(_error(None, -32603, "internal error", str(e)))
 
@@ -405,12 +435,14 @@ class RPCServer:
                             await ws.send_json(
                                 _result(id_, await self._dispatch(method, handler, params))
                             )
-                        except RPCShedError:
+                        except (RPCShedError, ErrLightOverloaded):
                             await ws.send_json(
                                 _error(id_, ERR_SHED, "server overloaded", {"method": method})
                             )
                         except MempoolError as e:
                             await ws.send_json(self._mempool_reject(id_, e))
+                        except LightServiceError as e:
+                            await ws.send_json(_error(id_, e.code, str(e), e.data))
                         except Exception as e:
                             await ws.send_json(_error(id_, -32603, "internal error", str(e)))
         finally:
@@ -952,7 +984,13 @@ class RPCServer:
         device_up/init/last-call-age gauges node liveness reads."""
         from tendermint_tpu.libs import trace
 
-        return trace.verify_stats()
+        out = trace.verify_stats()
+        svc = getattr(self.node, "light_service", None)
+        if svc is not None:
+            # the serving subsystem's consumption of the pipeline above —
+            # one stats read covers the device AND who it verified for
+            out["light"] = svc.stats()
+        return out
 
     async def _consensus_timeline(self, params) -> dict:
         """Per-height/round consensus timeline ring
@@ -1054,6 +1092,9 @@ class RPCServer:
          "all_gather traffic, AOT cache outcomes", False),
         ("/debug/slo", "declared latency budgets, per-window burn rates and "
          "guard trips ([slo] config)", False),
+        ("/debug/light", "light-client-as-a-service snapshot: trusted span, "
+         "cache/single-flight counters, coalesced flushes, sheds, "
+         "conflicting-header detections", False),
         ("/debug/device_profile", "on-demand jax profiler capture; "
          "?action=start|stop|status (start/stop need rpc.unsafe)", True),
         ("/metrics", "Prometheus exposition (needs instrumentation."
@@ -1079,6 +1120,104 @@ class RPCServer:
         if eng is None:
             return {"enabled": False, "objectives": {}}
         return eng.snapshot()
+
+    # -- light-client-as-a-service (light/service.py) -----------------------
+
+    def _light_service(self):
+        svc = getattr(self.node, "light_service", None)
+        if svc is None:
+            # structured refusal: a deliberately disabled service must not
+            # produce -32603 + a stack trace per request
+            raise ErrLightDisabled(
+                "light service is disabled (set light_service.enabled = true)"
+            )
+        return svc
+
+    @staticmethod
+    def _decode_hash_param(params) -> Optional[bytes]:
+        h = params.get("hash", "")
+        if not h:
+            return None
+        try:
+            if isinstance(h, str):
+                out = bytes.fromhex(h[2:] if h.startswith("0x") else h)
+            elif isinstance(h, (bytes, bytearray, list)):
+                out = bytes(h)
+            else:
+                raise TypeError(f"unsupported type {type(h).__name__}")
+        except (ValueError, TypeError) as e:
+            raise ErrBadRequest(f"invalid hash parameter: {e}") from e
+        if len(out) != 32:
+            # a short/garbage hash must be a bad request, never a
+            # conflicting-header "attack" detection
+            raise ErrBadRequest(
+                f"invalid hash parameter: want 32 bytes, got {len(out)}"
+            )
+        return out
+
+    @staticmethod
+    def _decode_height_param(params) -> int:
+        try:
+            return int(params.get("height") or 0)
+        except (ValueError, TypeError) as e:
+            raise ErrBadRequest(f"invalid height parameter: {e}") from e
+
+    async def _light_verified_result(self, params) -> tuple:
+        """Shared body of light_verify/light_block: parse params, verify
+        through the service, build the base response. Returns (result,
+        light_block) so light_block can append the validator set."""
+        svc = self._light_service()
+        height = self._decode_height_param(params)
+        lb, source = await svc.verify_height(
+            height, expected_hash=self._decode_hash_param(params)
+        )
+        return {
+            "height": str(lb.height),
+            "hash": lb.hash().hex().upper(),
+            "source": source,
+            "signed_header": {
+                "header": header_to_json(lb.header),
+                "commit": commit_to_json(lb.signed_header.commit),
+            },
+            "light_client_verified": True,
+        }, lb
+
+    async def _light_verify(self, params) -> dict:
+        """Server-side skipping verification (the light-client-as-a-service
+        fast path): verify the commit at `height` against the service's
+        trusted span — answered from the verified-header cache, a shared
+        coalesced device flush, or the bisection fallback. Optional `hash`
+        is the client's expected header hash; a mismatch is a structured
+        conflicting-header error (code -32010), not a 500. Sheddable under
+        the LoadGate (429 + Retry-After) so a light flood never starves
+        consensus."""
+        result, _lb = await self._light_verified_result(params)
+        return result
+
+    async def _light_block(self, params) -> dict:
+        """light_verify + the validator set: everything a downstream light
+        client needs to extend its own trust from this height."""
+        from tendermint_tpu.types.light import validator_set_to_json
+
+        result, lb = await self._light_verified_result(params)
+        result["validator_set"] = validator_set_to_json(lb.validator_set)
+        return result
+
+    async def _light_status(self, params) -> dict:
+        """Service status: trusted span, cache occupancy, window policy,
+        current pending load. Bypasses the gate like `status` — a client
+        deciding whether to retry must always get an answer."""
+        return self._light_service().status()
+
+    async def _debug_light(self, params) -> dict:
+        """GET /debug/light: the light service's full counter snapshot
+        (requests by outcome, cache hits, single-flight waits, coalesced
+        flushes + lanes, bisections, sheds, conflicting headers). Read-only,
+        served regardless of rpc.unsafe (like /debug/verify_stats)."""
+        svc = getattr(self.node, "light_service", None)
+        if svc is None:
+            return {"enabled": False}
+        return svc.stats()
 
     async def _debug_device_profile(self, params) -> dict:
         """On-demand device profiler capture (libs/profiler.py over

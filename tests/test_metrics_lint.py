@@ -180,6 +180,10 @@ METRICS_SETS = (
     # (proposal/vote_propagation_seconds, clock_skew_seconds) which ride the
     # classes above
     M.SLOMetrics,
+    # light-client-as-a-service (ISSUE 9): tendermint_light_* fed by
+    # light/service.py (requests by outcome, cache hits, coalesced lanes
+    # per flush, sheds, conflicting-header detections)
+    M.LightServiceMetrics,
 )
 
 
