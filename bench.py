@@ -1022,7 +1022,7 @@ def bench_overload():
 
     mm = node.metrics.mempool
     rejected = {k[0]: int(v) for k, v in mm.rejected_txs._values.items()}
-    return {
+    out = {
         "baseline_block_interval_ms": round(baseline_s * 1e3, 1),
         "flood_block_interval_ms": round(flood_s * 1e3, 1),
         "block_interval_ratio": round(flood_s / baseline_s, 2),
@@ -1033,6 +1033,20 @@ def bench_overload():
         "rejected_txs": rejected,
         "overload": node.overload.snapshot(),
     }
+    # per-stage lifecycle waterfall under flood (libs/txtrace.py): the perf
+    # ledger's trajectory gains latency ATTRIBUTION columns — where between
+    # admission and commit the flood's txs spent their time, and how each
+    # journey ended — not just throughput
+    tt = getattr(node, "tx_tracker", None)
+    if tt is not None:
+        tstats = tt.stats()
+        out["tx_stage_waterfall"] = {
+            "stage_percentiles": tstats["stage_percentiles"],
+            "terminals": tstats["terminals"],
+            "tracked": tstats["tracked"],
+            "ring_evictions": tstats["ring_evictions"],
+        }
+    return out
 
 
 def make_light_chain(heights: int, n_vals: int, chain_id: str = "bench-light"):
@@ -1207,6 +1221,9 @@ def bench_light_serve(
         "cache_hits": stats["cache_hits"],
         "singleflight_waits": stats["singleflight_waits"],
         "windows_fired": stats["coalescer"]["windows_fired"],
+        # per-request stage attribution (ISSUE 10): the p99 above decomposed
+        # into cache probe / coalesce wait / flush wall / bisection
+        "stage_percentiles": stats.get("stage_percentiles", {}),
     }
 
 
@@ -1402,8 +1419,11 @@ def scenario_main(name: str) -> None:
     # hang (SIGALRM unserviced, the BENCH_r05 mode) still leaves a
     # FORENSICS_*.json naming the wedged phase for the parent to attach.
     try:
+        # fallback is the forensics runtime dir, NEVER the cwd: an unset
+        # TMTPU_FORENSICS_DIR used to open heartbeat_<pid>.bin rings in the
+        # repo root (the ISSUE 10 strays), bypassing the PR 8 dir resolution
         _forensics.configure(
-            os.environ.get("TMTPU_FORENSICS_DIR") or os.getcwd()
+            os.environ.get("TMTPU_FORENSICS_DIR") or _forensics.DEFAULT_DIR
         )
         _forensics.install_signal_handler()
     except Exception:
@@ -1463,7 +1483,9 @@ def _forensics_for_kill(t_child_start: float) -> dict:
 
     out: dict = {}
     try:
-        d = os.environ.get("TMTPU_FORENSICS_DIR") or os.getcwd()
+        # must mirror scenario_main's configure fallback (the runtime dir,
+        # not cwd) or the parent reads an empty directory
+        d = os.environ.get("TMTPU_FORENSICS_DIR") or _forensics.DEFAULT_DIR
         # small rewind: the capture's mtime can predate communicate()'s
         # timeout bookkeeping by the watchdog margin
         paths = _forensics.find_captures(d, since_ts=t_child_start - 1.0)

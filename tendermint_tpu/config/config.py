@@ -232,6 +232,13 @@ class SLOConfig:
     # one light_verify request, admission -> verified response (the serving
     # subsystem's p99 budget; fed by light/service.py per request)
     light_verify_p99: float = 0.5
+    # a tx's first receipt (rpc|gossip) -> commit in a finalized block
+    # (fed by libs/txtrace.py; the "where is my transaction" budget)
+    tx_commit_latency: float = 10.0
+    # one dispatched RPC request, any method (fed per request by
+    # rpc/server.py's shared _dispatch; with target=0.99 this is the
+    # serving path's p99 bound)
+    rpc_request_p99: float = 1.0
 
 
 @dataclass
@@ -355,6 +362,12 @@ class InstrumentationConfig:
     # GET /debug/device_profile) write run dirs here; empty = a tmtpu_profiles
     # dir under the system temp dir.
     profile_dir: str = ""
+    # Transaction lifecycle tracker (libs/txtrace.py): bounded per-tx
+    # journey ring behind the tx_status route and GET /debug/tx_trace.
+    # Recording itself is gated on trace_enabled (one flag, one contract);
+    # txtrace_enabled=false skips constructing the tracker entirely.
+    txtrace_enabled: bool = True
+    txtrace_ring: int = 8192
     # Stall forensics (libs/forensics.py): device entry points heartbeat
     # phase stamps into an mmap'd ring under this dir and FORENSICS_*.json
     # captures land there — NEVER the repo/app root (ISSUE 8 satellite).

@@ -100,9 +100,14 @@ class ConsensusState:
         metrics=None,
         timeline=None,
         slo=None,
+        tx_tracker=None,
     ):
         self.config = config
         self.metrics = metrics
+        # tx lifecycle tracker (libs/txtrace.py): consensus contributes the
+        # proposed(height,round) and committed(height,index) stages; gated on
+        # the tracer flag like the timeline, muted during replay
+        self.tx_tracker = tx_tracker
         # per-height/round timeline ring (consensus/timeline.py), served by
         # GET /debug/consensus_timeline; recording is gated on tracer.enabled
         # so a disabled recorder costs the hot path only flag checks
@@ -483,6 +488,18 @@ class ConsensusState:
             return None
         return tl
 
+    def _track_block_txs(self, stage: str, height: int, round_: int, block) -> None:
+        """Stamp a lifecycle stage for every tracked tx of `block` — one
+        flag check when tracing is off or no tracker is wired (the hashing
+        inside record_block never runs)."""
+        tt = self.tx_tracker
+        if (
+            tt is None or not tt.enabled or self.replay_mode
+            or block is None or not block.txs
+        ):
+            return
+        tt.record_block(stage, height, round_, block.txs)
+
     def _mark_step(self) -> None:
         """Close the previous step's duration and open the new one — the
         analog of the reference's metrics.MarkStep (CometBFT
@@ -734,6 +751,10 @@ class ConsensusState:
             rs.proposal_block = Block.decode(data)
             logger.info("received complete proposal block %s %s", rs.proposal_block.header.height,
                         rs.proposal_block.hash().hex()[:12])
+            # tx lifecycle: every tracked tx of the now-complete proposal is
+            # `proposed` (our own proposals land here too — their parts ride
+            # internal BlockPartMessages through this same path)
+            self._track_block_txs("proposed", rs.height, rs.round, rs.proposal_block)
             self._publish_rs(EVENT_COMPLETE_PROPOSAL)
 
             prevotes = rs.votes.prevotes(rs.round)
@@ -954,6 +975,7 @@ class ConsensusState:
         tl = self._tl()
         if tl is not None:
             tl.record_commit(height, rs.commit_round, txs=len(block.txs))
+        self._track_block_txs("committed", height, rs.commit_round, block)
         if self.metrics is not None:
             m = self.metrics
             if (

@@ -531,10 +531,14 @@ class StateSyncMetrics:
 
 
 class RPCMetrics:
-    """rpc/server.py load-shedding gate. No reference counterpart — the
-    reference bounds connections at the listener (MaxOpenConnections);
-    here the gate is per-request so health/consensus routes stay served
-    while broadcast/query traffic sheds."""
+    """rpc/server.py load-shedding gate + per-method request telemetry. No
+    reference counterpart — the reference bounds connections at the listener
+    (MaxOpenConnections); here the gate is per-request so health/consensus
+    routes stay served while broadcast/query traffic sheds, and every
+    dispatched request is attributed to its method (ISSUE 10: "why was my
+    request slow?"). Method label cardinality is bounded to the declared
+    route table — unknown methods fold into `_other` (rpc/server.py
+    _method_label)."""
 
     def __init__(self, reg: Registry):
         ns = f"{NAMESPACE}_rpc"
@@ -546,6 +550,20 @@ class RPCMetrics:
             f"{ns}_shed_requests_total",
             "Requests refused with 429 (gate full or overload pressure), by method.",
             ("method",),
+        )
+        self.request_duration = reg.histogram(
+            f"{ns}_request_duration_seconds",
+            "Wall seconds from dispatch to response per method (all "
+            "transports + LocalClient route through the shared _dispatch).",
+            ("method",),
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5,
+                     5.0, 10.0),
+        )
+        self.requests = reg.counter(
+            f"{ns}_requests_total",
+            "Dispatched RPC requests by method and outcome "
+            "(ok/shed/reject/error).",
+            ("method", "outcome"),
         )
 
 
@@ -827,6 +845,37 @@ class LightServiceMetrics:
         )
 
 
+class TxLifecycleMetrics:
+    """Transaction lifecycle accounting (libs/txtrace.py): per-stage
+    transition latencies and terminal outcomes of the tx journey
+    received -> checked -> admitted -> gossiped -> proposed -> committed ->
+    delivered. No reference counterpart — the reference's tx story ends at
+    the mempool gauge; this is the layer that answers "where is my
+    transaction?" per hash (the `tx_status` route reads the same ring)."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_tx"
+        self.stage_seconds = reg.histogram(
+            f"{ns}_stage_seconds",
+            "Wall seconds spent reaching each lifecycle stage from the "
+            "previous one (received/checked/admitted/first_gossiped/"
+            "proposed/committed/delivered + terminal rejects).",
+            ("stage",),
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5,
+                     5.0, 15.0, 60.0),
+        )
+        self.terminal_total = reg.counter(
+            f"{ns}_terminal_total",
+            "Tx journeys ended, by outcome (delivered/rejected/evicted/"
+            "expired).",
+            ("outcome",),
+        )
+        self.tracked = reg.gauge(
+            f"{ns}_tracked",
+            "Tx journeys currently held in the lifecycle ring.",
+        )
+
+
 class ChaosMetrics:
     """tendermint_tpu/chaos engine accounting: how many faults a soak/smoke
     injected per level. Exposed so a chaos run's /metrics scrape shows the
@@ -910,6 +959,7 @@ class NodeMetrics:
         self.overload = OverloadMetrics(self.registry)
         self.slo = SLOMetrics(self.registry)
         self.light = LightServiceMetrics(self.registry)
+        self.txtrace = TxLifecycleMetrics(self.registry)
         NodeMetrics._latest = self
 
     @classmethod

@@ -69,6 +69,21 @@ OBJECTIVES = {
         "seconds from a light_verify request's admission to its verified "
         "response (cache, coalesced flush, or bisection fallback)",
     ),
+    # ISSUE 10: the user-facing serving budgets, fed by the tx lifecycle
+    # tracker (libs/txtrace.py, first receipt -> commit) and the shared RPC
+    # _dispatch (rpc/server.py, per-request wall). With target=0.99 the
+    # per-request budget IS the p99 bound: >1% of requests over budget
+    # burns the error budget at trip rate.
+    "tx_commit_latency": (
+        "tx_commit_latency",
+        "seconds from a tx's first receipt (rpc or gossip) to its commit "
+        "in a finalized block",
+    ),
+    "rpc_request_p99": (
+        "rpc_request_p99",
+        "wall seconds of one dispatched RPC request, any method "
+        "(all transports + LocalClient)",
+    ),
 }
 
 # ring bound per objective: at soak rates (~10 obs/s) this covers the slow

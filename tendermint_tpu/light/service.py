@@ -45,6 +45,8 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tendermint_tpu.libs.kvdb import MemDB
+from tendermint_tpu.libs.trace import tracer as _tracer
+from tendermint_tpu.libs.txtrace import StageStats
 from tendermint_tpu.light import verifier
 from tendermint_tpu.light.client import Client, ErrConflictingHeaders, TrustOptions
 from tendermint_tpu.light.coalescer import Coalescer
@@ -270,6 +272,14 @@ class LightService:
         self.sheds = 0
         self.conflicts = 0
         self.outcomes: Dict[str, int] = {}
+        # per-request stage spans (ISSUE 10): a slow light_verify p99 is
+        # attributable to a STAGE — admission backstop, cache probe,
+        # single-flight wait, provider fetch, coalesce-window wait, the
+        # shared device flush wall, or the bisection walk — instead of one
+        # opaque number. Recording is gated on the tracer flag (the
+        # hotstats contract: disabled costs one flag check per site);
+        # percentiles surface in light_status / GET /debug/light.
+        self.stage_stats = StageStats()
 
     # -- public API -----------------------------------------------------------
 
@@ -317,12 +327,22 @@ class LightService:
             while len(self._hot) > self._hot_cap:
                 self._hot.popitem(last=False)
 
+    def _span(self, stage: str, t0: float) -> None:
+        """Record one per-request stage duration — one flag check when
+        tracing is off (stage taxonomy: admission, cache_probe,
+        singleflight_wait, provider_fetch, coalesce_wait, flush_wall,
+        bisection)."""
+        if _tracer.enabled:
+            self.stage_stats.observe(stage, time.perf_counter() - t0)
+
     async def _verify_height_inner(self, height: int) -> Tuple[LightBlock, str]:
+        t_probe = time.perf_counter()
         cached = self._hot_get(height)
         if cached is None:
             cached = self.store.light_block(height)
             if cached is not None:
                 self._hot_put(cached)
+        self._span("cache_probe", t_probe)
         if cached is not None:
             with self._counter_lock:
                 self.cache_hits += 1
@@ -335,7 +355,9 @@ class LightService:
         if fut is not None:
             with self._counter_lock:
                 self.singleflight_waits += 1
+            t_wait = time.perf_counter()
             kind, value = await asyncio.shield(fut)
+            self._span("singleflight_wait", t_wait)
             if kind == "err":
                 raise value
             if kind == "retry":
@@ -371,6 +393,7 @@ class LightService:
             self._inflight.pop(height, None)
 
     async def _verify_miss(self, height: int) -> Tuple[LightBlock, str]:
+        t_adm = time.perf_counter()
         if self.max_pending > 0 and self._pending >= self.max_pending:
             with self._counter_lock:
                 self.sheds += 1
@@ -382,12 +405,19 @@ class LightService:
         self._pending += 1
         try:
             await self._ensure_anchor()
+            # the admission span covers the backstop check + anchor wait —
+            # on a cold service the first requests pay the anchor
+            # verification here, and the span names that
+            self._span("admission", t_adm)
+            t_fetch = time.perf_counter()
             try:
                 target = await self.provider.light_block(height)
             except ErrLightBlockNotFound as e:
                 raise ErrHeightNotAvailable(str(e)) from e
             except ProviderError as e:
                 raise ErrHeightNotAvailable(f"provider failed: {e}") from e
+            finally:
+                self._span("provider_fetch", t_fetch)
             try:
                 # hashing-heavy for large valsets — off the shared loop
                 await asyncio.get_running_loop().run_in_executor(
@@ -406,15 +436,22 @@ class LightService:
             if trusted is None or verifier.header_expired(
                 trusted.signed_header, self.trust_period_ns, self._now_ns()
             ):
-                lb = await self._bisect(height)
+                lb = await self._bisect_spanned(height)
                 source = "bisection"
             else:
                 try:
-                    lb = await self.coalescer.submit(
-                        _Job(height=height, target=target, trusted=trusted)
-                    )
+                    t_coal = time.perf_counter()
+                    try:
+                        lb = await self.coalescer.submit(
+                            _Job(height=height, target=target, trusted=trusted)
+                        )
+                    finally:
+                        # window-arm wait + the shared flush, as this request
+                        # experienced it (the flush wall alone is recorded
+                        # per-window by _run_jobs)
+                        self._span("coalesce_wait", t_coal)
                 except _NeedBisection:
-                    lb = await self._bisect(height)
+                    lb = await self._bisect_spanned(height)
                     source = "bisection"
                 except (CommitVerifyError, ErrInvalidHeader, LightError) as e:
                     raise ErrVerificationFailed(
@@ -471,6 +508,13 @@ class LightService:
             except (ValueError, CommitVerifyError) as e:
                 raise ErrVerificationFailed(f"anchor rejected: {e}") from e
             self.store.save_light_block(lb)
+
+    async def _bisect_spanned(self, height: int) -> LightBlock:
+        t0 = time.perf_counter()
+        try:
+            return await self._bisect(height)
+        finally:
+            self._span("bisection", t0)
 
     async def _bisect(self, height: int) -> LightBlock:
         """Bisection fallback (light/client.py) for heights the direct
@@ -539,6 +583,7 @@ class LightService:
 
         now_ns = self._now_ns()
         prepared: List = []
+        t_flush = time.perf_counter()
         with _batch.accumulate_flushes() as acc:
             for job in jobs:
                 try:
@@ -547,6 +592,9 @@ class LightService:
                     prepared.append(e)
             lanes = acc.lanes
         acc.flush()  # the one device flush for this window
+        # one sample per WINDOW (submit phases + the shared device flush):
+        # the wall every rider of this window shares
+        self._span("flush_wall", t_flush)
         results = []
         for job, fins in zip(jobs, prepared):
             if isinstance(fins, Exception):
@@ -649,6 +697,10 @@ class LightService:
             "max_heights_per_flush": self.coalescer.max_jobs,
             "max_pending": self.max_pending,
             "pending": self._pending,
+            # per-request stage latency attribution (ISSUE 10): a slow p99
+            # names its stage — cache_probe / singleflight_wait / admission /
+            # provider_fetch / coalesce_wait / flush_wall / bisection
+            "stage_percentiles": self.stage_stats.percentiles(),
         }
 
     def stats(self) -> dict:

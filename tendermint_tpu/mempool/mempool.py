@@ -111,8 +111,13 @@ class Mempool:
         ttl_seconds: float = 0.0,
         eviction: bool = True,
         max_txs_per_sender: int = 0,
+        tx_tracker=None,
     ):
         self.metrics = metrics
+        # tx lifecycle tracker (libs/txtrace.py): admission is where a tx's
+        # journey forks — admitted, rejected{reason}, evicted, or expired.
+        # Every hook below is gated on tracker.enabled (the tracer flag).
+        self.tx_tracker = tx_tracker
         self._wal = None
         if wal_path:
             self.init_wal(wal_path)
@@ -246,12 +251,23 @@ class Mempool:
             self._cache.popitem(last=False)
         return True
 
-    def _reject(self, exc: MempoolError, sender: str):
+    def _tt(self):
+        """The lifecycle tracker iff recording is on — one attribute read +
+        one flag check when disabled (the hotstats contract)."""
+        tt = self.tx_tracker
+        if tt is None or not tt.enabled:
+            return None
+        return tt
+
+    def _reject(self, exc: MempoolError, sender: str, key: bytes = b""):
         """Reject a tx at admission: gossiped txs (sender set) drop silently
         (the reference updates sender lists and moves on), locally submitted
         txs raise so the RPC layer can report the structured reason."""
         if self.metrics is not None:
             self.metrics.rejected_txs.labels(exc.reason).inc()
+        tt = self._tt()
+        if tt is not None and key:
+            tt.record(key, "rejected", reason=exc.reason)
         if sender:
             return None
         raise exc
@@ -264,24 +280,44 @@ class Mempool:
         in the cache from a peer returns None instead of raising (the
         reference updates the sender list and drops it silently)."""
         with self._lock:
+            tt = self._tt()
+            # hash EARLY only when the tracker is live (the journey needs its
+            # key before the early rejects); disabled, the hot path hashes at
+            # the cache point exactly as before — a flood of oversized/
+            # over-quota txs costs no SHA-256 under the lock
+            key = tmhash.sum256(tx) if tt is not None else b""
+            if tt is not None:
+                # journey ingress: dedupe inside the tracker (an RPC hook may
+                # have stamped it already; a re-gossip of a live journey is
+                # not a second receipt)
+                tt.record(key, "received", via="gossip" if sender else "rpc")
             if len(tx) > self.max_tx_bytes:
-                return self._reject(TxTooLargeError(len(tx), self.max_tx_bytes), sender)
+                return self._reject(TxTooLargeError(len(tx), self.max_tx_bytes), sender, key)
             if (
                 sender
                 and self.max_txs_per_sender > 0
                 and self._sender_counts.get(sender, 0) >= self.max_txs_per_sender
             ):
-                return self._reject(SenderQuotaError(sender, self.max_txs_per_sender), sender)
+                return self._reject(SenderQuotaError(sender, self.max_txs_per_sender), sender, key)
             if self.is_full(len(tx)) and not self.eviction:
-                return self._reject(MempoolFullError(), sender)
-            key = tmhash.sum256(tx)
+                return self._reject(MempoolFullError(), sender, key)
+            if not key:
+                key = tmhash.sum256(tx)
             if not self._cache_push(key):
                 mtx = self._txs.get(key)
-                if mtx is not None and sender:
-                    mtx.senders = mtx.senders | {sender}
-                    return None
-                return self._reject(TxInCacheError(), sender)
+                if mtx is not None:
+                    if sender:
+                        mtx.senders = mtx.senders | {sender}
+                        return None
+                    # duplicate local submission of a RESIDENT tx: refuse
+                    # the submission but never terminal the live journey —
+                    # the tx is still on its way to a block, and tx_status
+                    # must keep saying so (key=b"" skips the record)
+                    return self._reject(TxInCacheError(), sender, b"")
+                return self._reject(TxInCacheError(), sender, key)
             res = self.proxy_app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+            if tt is not None:
+                tt.record(key, "checked", code=res.code, priority=res.priority)
             if res.code == abci.CODE_TYPE_OK:
                 # evict only for a genuinely NEW arrival: a duplicate of a
                 # resident tx whose hash churned out of the dedup cache must
@@ -293,7 +329,7 @@ class Mempool:
                         # may re-enter once the pool drains
                         self._cache.pop(key, None)
                         return self._reject(
-                            MempoolFullError("no evictable lower-priority txs"), sender
+                            MempoolFullError("no evictable lower-priority txs"), sender, key
                         )
                     self._txs[key] = MempoolTx(
                         tx=tx, height=self._height, gas_wanted=res.gas_wanted,
@@ -305,12 +341,16 @@ class Mempool:
                         self._sender_counts[sender] = self._sender_counts.get(sender, 0) + 1
                     self._total_bytes += len(tx)
                     self._wal_write(tx)
+                    if tt is not None:
+                        tt.record(key, "admitted", priority=res.priority)
                     self._notify_txs_available()
             else:
                 if not self.keep_invalid_txs_in_cache:
                     self._cache.pop(key, None)
                 if self.metrics is not None:
                     self.metrics.failed_txs.inc()
+                if tt is not None:
+                    tt.record(key, "rejected", reason="checktx", code=res.code)
             self._update_size_metrics(len(tx))
             return res
 
@@ -364,11 +404,14 @@ class Mempool:
             freed_slots += 1
         if freed_slots < need_slots or freed_bytes < need_bytes:
             return False
+        tt = self._tt()
         for key in victims:
-            self._remove_tx(key, drop_cache=True)
+            mtx = self._remove_tx(key, drop_cache=True)
             self.evicted_total += 1
             if self.metrics is not None:
                 self.metrics.evicted_txs.inc()
+            if tt is not None and mtx is not None:
+                tt.record(key, "evicted", priority=mtx.priority)
         return True
 
     def entries(self) -> List[tuple]:
@@ -451,13 +494,17 @@ class Mempool:
                 and now_ns - mtx.time_ns >= self.ttl_seconds * 1e9
             )
         ]
+        tt = self._tt()
         for key in expired:
             self._remove_tx(key, drop_cache=True)
             self.expired_total += 1
             if self.metrics is not None:
                 self.metrics.expired_txs.inc()
+            if tt is not None:
+                tt.record(key, "expired", height=self._height)
 
     def _recheck_txs(self) -> None:
+        tt = self._tt()
         for key in list(self._txs.keys()):
             mtx = self._txs[key]
             res = self.proxy_app.check_tx(
@@ -467,3 +514,7 @@ class Mempool:
                 self._remove_tx(
                     key, drop_cache=not self.keep_invalid_txs_in_cache
                 )
+                # the journey must not read "admitted" forever after the
+                # node silently dropped the tx on a failed recheck
+                if tt is not None:
+                    tt.record(key, "rejected", reason="recheck", code=res.code)

@@ -120,6 +120,12 @@ class MempoolReactor(Reactor):
                     if ok:
                         sent.add(key)
                         progress = True
+                        tt = getattr(self.mempool, "_tt", lambda: None)()
+                        if tt is not None:
+                            # first successful fan-out only — the tracker
+                            # dedupes repeats, so the stage names when the tx
+                            # FIRST left this node, not how many peers got it
+                            tt.record(key, "first_gossiped", peer=peer.id[:10])
                 if not progress:
                     await asyncio.sleep(BROADCAST_SLEEP)
                 # GC the sent-set against the live mempool

@@ -136,6 +136,20 @@ class Node:
             self.slo = _slo.SLOEngine(config.slo, metrics=self.metrics.slo)
             _slo.set_default(self.slo)
 
+        # tx lifecycle tracker (libs/txtrace.py, ISSUE 10): the bounded
+        # per-tx journey ring behind tx_status / GET /debug/tx_trace.
+        # Node-local; recording follows the tracer's enabled flag, and the
+        # committed stage feeds the tx_commit_latency SLO budget.
+        self.tx_tracker = None
+        if getattr(config.instrumentation, "txtrace_enabled", True):
+            from tendermint_tpu.libs.txtrace import TxTracker
+
+            self.tx_tracker = TxTracker(
+                max_txs=getattr(config.instrumentation, "txtrace_ring", 8192),
+                metrics=self.metrics.txtrace,
+                slo=self.slo,
+            )
+
         # per-height/round consensus timeline ring (consensus/timeline.py) —
         # node-local (unlike the tracer), served by /debug/consensus_timeline;
         # recording is gated on the tracer's enabled flag in cs_state
@@ -213,6 +227,7 @@ class Node:
             ttl_seconds=config.mempool.ttl_seconds,
             eviction=config.mempool.eviction,
             max_txs_per_sender=config.mempool.max_txs_per_sender,
+            tx_tracker=self.tx_tracker,
         )
 
         # evidence pool
@@ -228,6 +243,7 @@ class Node:
             event_bus=self.event_bus,
             block_store=self.block_store,
             metrics=self.metrics.state,
+            tx_tracker=self.tx_tracker,
         )
 
         # consensus
@@ -255,6 +271,7 @@ class Node:
             metrics=self.metrics.consensus,
             timeline=self.timeline,
             slo=self.slo,
+            tx_tracker=self.tx_tracker,
         )
 
         self.rpc_server = None

@@ -6,8 +6,10 @@ node's internals (the reference's rpc/core Environment role)."""
 from __future__ import annotations
 
 import asyncio
+import heapq
 import json
 import logging
+import time
 from typing import Any, Dict, Optional
 
 from aiohttp import web, WSMsgType
@@ -64,7 +66,7 @@ ERR_MEMPOOL = -32001  # mempool rejected the tx (data carries the reason)
 SHEDDABLE_METHODS = frozenset({
     "broadcast_tx_async", "broadcast_tx_sync", "broadcast_tx_commit",
     "check_tx", "abci_query", "abci_info",
-    "tx", "tx_search", "block_search",
+    "tx", "tx_status", "tx_search", "block_search",
     "block", "blockchain", "block_results", "block_by_hash", "commit",
     "unconfirmed_txs",
     # light-client serving (light/service.py): per-client admission rides
@@ -119,6 +121,32 @@ class LoadGate:
             self.metrics.inflight_requests.set(self.inflight)
 
 
+class SlowRequestRing:
+    """Bounded top-N-by-duration request ring (ISSUE 10): the structured
+    annotations an operator reads at GET /debug/rpc to answer "why was my
+    request slow" — method, wall duration, outcome, error detail, and the
+    gate pressure (inflight count + shed switches) the request saw at
+    dispatch. A min-heap keyed on duration keeps exactly the N slowest;
+    offering a faster-than-the-floor request is O(1)."""
+
+    def __init__(self, cap: int = 32):
+        self.cap = max(1, int(cap))
+        self._heap: list = []  # (duration_s, seq, entry)
+        self._seq = 0
+
+    def offer(self, duration_s: float, entry: dict) -> None:
+        if len(self._heap) >= self.cap and duration_s <= self._heap[0][0]:
+            return
+        self._seq += 1
+        heapq.heappush(self._heap, (duration_s, self._seq, entry))
+        while len(self._heap) > self.cap:
+            heapq.heappop(self._heap)
+
+    def snapshot(self) -> list:
+        """Slowest first."""
+        return [e for _, _, e in sorted(self._heap, key=lambda t: -t[0])]
+
+
 class RPCServer:
     def __init__(self, node):
         self.node = node
@@ -142,6 +170,8 @@ class RPCServer:
         self.app.router.add_get("/debug/mesh", self._handle_debug_mesh)
         self.app.router.add_get("/debug/slo", self._handle_debug_slo)
         self.app.router.add_get("/debug/light", self._handle_debug_light)
+        self.app.router.add_get("/debug/tx_trace", self._handle_debug_tx_trace)
+        self.app.router.add_get("/debug/rpc", self._handle_debug_rpc)
         self.app.router.add_get(
             "/debug/device_profile", self._handle_debug_device_profile
         )
@@ -199,24 +229,131 @@ class RPCServer:
             "light_block": self._light_block,
             "light_status": self._light_status,
             "debug_light": self._debug_light,
+            # transaction & request observatory (libs/txtrace.py, ISSUE 10)
+            "tx_status": self._tx_status,
+            "debug_tx_trace": self._debug_tx_trace,
+            "debug_rpc": self._debug_rpc,
         }
+        # per-method request telemetry (ISSUE 10): every transport routes
+        # through _dispatch, which observes duration + outcome per method
+        # (label cardinality bounded to this route table; unknown methods
+        # fold into "_other") and feeds the slowest requests into a bounded
+        # top-N ring served at GET /debug/rpc
+        self.slow_ring = SlowRequestRing(cap=32)
+        self._method_agg: Dict[str, dict] = {}
 
     # -- load shedding -------------------------------------------------------
 
     async def _dispatch(self, method: str, handler, params):
-        """All transports (JSON-RPC POST, URI GET, websocket) route through
-        the gate here; a refused request raises RPCShedError for the
-        transport to translate (HTTP 429 + Retry-After)."""
+        """All transports (JSON-RPC POST, URI GET, websocket; LocalClient
+        too) route through the gate here; a refused request raises
+        RPCShedError for the transport to translate (HTTP 429 +
+        Retry-After). Every dispatched request — admitted or shed — is
+        observed once: per-method duration histogram + outcome counter
+        (tendermint_rpc_request_*), the rpc_request_p99 SLO budget, and the
+        slow-request ring behind GET /debug/rpc."""
+        t0 = time.perf_counter()
+        inflight0 = self.gate.inflight
         if not self.gate.admits(method):
             self.gate.record_shed(method)
+            self._observe_request(
+                method, time.perf_counter() - t0, "shed", inflight0,
+                error="gate refused (429)",
+            )
             raise RPCShedError(method)
-        if method not in SHEDDABLE_METHODS:
-            return await handler(params)
-        self.gate.enter()
+        entered = method in SHEDDABLE_METHODS
+        if entered:
+            self.gate.enter()
+        outcome, error = "ok", None
         try:
             return await handler(params)
+        except asyncio.CancelledError:
+            # client disconnect / shutdown, not a request outcome — don't
+            # mint error series or slow-ring entries for aborts
+            outcome = None
+            raise
+        except ErrLightOverloaded as e:
+            outcome, error = "shed", f"{e.code}: light overloaded"
+            raise
+        except MempoolError as e:
+            # structured admission refusals are the serving path WORKING,
+            # not erroring — attribute them separately from 500s
+            outcome, error = "reject", f"mempool {getattr(e, 'reason', '?')}"
+            raise
+        except LightServiceError as e:
+            outcome, error = "reject", f"{e.code}: {type(e).__name__}"
+            raise
+        except BaseException as e:
+            outcome, error = "error", type(e).__name__
+            raise
         finally:
-            self.gate.exit()
+            if entered:
+                self.gate.exit()
+            if outcome is not None:
+                self._observe_request(
+                    method, time.perf_counter() - t0, outcome, inflight0, error
+                )
+
+    def _method_label(self, method: str) -> str:
+        """Bound the per-method label space to the declared route table —
+        a client probing made-up method names must not mint unbounded
+        metric series (they fold into `_other`)."""
+        return method if method in self._routes else "_other"
+
+    SLOW_RING_MIN_S = 0.001  # sub-ms requests never displace real evidence
+
+    def _observe_request(
+        self,
+        method: str,
+        seconds: float,
+        outcome: str,
+        inflight0: int,
+        error: Optional[str] = None,
+    ) -> None:
+        label = self._method_label(method)
+        served = outcome != "shed"
+        m = self.gate.metrics  # RPCMetrics or None
+        if m is not None:
+            if served:
+                # sheds refuse in microseconds: feeding them into the
+                # latency histogram (or the p99 SLO below) would collapse
+                # the per-method p99 toward zero exactly while the node is
+                # refusing traffic — shed visibility is requests_total
+                # {outcome="shed"} + shed_requests_total, never latency
+                m.request_duration.labels(label).observe(seconds)
+            m.requests.labels(label, outcome).inc()
+        slo = getattr(self.node, "slo", None)
+        if slo is not None and served:
+            slo.observe("rpc_request_p99", seconds)
+        agg = self._method_agg.get(label)
+        if agg is None:
+            agg = self._method_agg[label] = {
+                "count": 0, "ok": 0, "shed": 0, "reject": 0, "error": 0,
+                "total_s": 0.0, "max_ms": 0.0,
+            }
+        agg["count"] += 1
+        agg[outcome] = agg.get(outcome, 0) + 1
+        if served:
+            agg["total_s"] += seconds
+            if seconds * 1e3 > agg["max_ms"]:
+                agg["max_ms"] = round(seconds * 1e3, 3)
+        if seconds >= self.SLOW_RING_MIN_S:
+            self.slow_ring.offer(
+                seconds,
+                {
+                    "method": label,
+                    "duration_ms": round(seconds * 1e3, 3),
+                    "ts": round(time.time(), 3),
+                    "outcome": outcome,
+                    "error": error,
+                    # gate pressure at dispatch: admission is immediate (no
+                    # queue wait), so congestion shows as inflight depth and
+                    # flipped shed switches rather than waiting time
+                    "inflight_at_dispatch": inflight0,
+                    "shed_writes": self.gate.shed_writes,
+                    "shed_reads": self.gate.shed_reads,
+                },
+            )
 
     def _shed_response(self, id_, method: str) -> web.Response:
         retry_after = getattr(self.node.config.rpc, "shed_retry_after", 1.0)
@@ -338,6 +475,25 @@ class RPCServer:
     async def _handle_debug_light(self, request: web.Request) -> web.Response:
         try:
             return web.json_response(_result(None, await self._debug_light({})))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
+
+    async def _handle_debug_tx_trace(self, request: web.Request) -> web.Response:
+        params = {k: v for k, v in request.query.items()}
+        try:
+            return web.json_response(
+                _result(None, await self._debug_tx_trace(params))
+            )
+        except LightServiceError as e:  # ErrBadRequest: malformed hash
+            return web.json_response(_error(None, e.code, str(e), e.data))
+        except ValueError as e:
+            return web.json_response(_error(None, -32602, "bad request", str(e)))
+        except Exception as e:
+            return web.json_response(_error(None, -32603, "internal error", str(e)))
+
+    async def _handle_debug_rpc(self, request: web.Request) -> web.Response:
+        try:
+            return web.json_response(_result(None, await self._debug_rpc({})))
         except Exception as e:
             return web.json_response(_error(None, -32603, "internal error", str(e)))
 
@@ -496,13 +652,24 @@ class RPCServer:
                 return tx.encode()
         return bytes(tx)
 
+    def _track_received(self, tx_hash: bytes) -> None:
+        """Stamp the journey's `received` at the RPC edge — BEFORE the
+        executor hop into mempool.check_tx, so the waterfall's first stage
+        includes executor queueing (the mempool re-stamp dedupes)."""
+        tt = getattr(self.node, "tx_tracker", None)
+        if tt is not None and tt.enabled:
+            tt.record(tx_hash, "received", via="rpc")
+
     async def _broadcast_tx_async(self, params) -> dict:
         tx = self._decode_tx_param(params)
+        tx_hash = tmhash.sum256(tx)
+        self._track_received(tx_hash)
         asyncio.get_event_loop().run_in_executor(None, self.node.mempool.check_tx, tx)
-        return {"code": 0, "data": "", "log": "", "hash": tmhash.sum256(tx).hex().upper()}
+        return {"code": 0, "data": "", "log": "", "hash": tx_hash.hex().upper()}
 
     async def _broadcast_tx_sync(self, params) -> dict:
         tx = self._decode_tx_param(params)
+        self._track_received(tmhash.sum256(tx))
         res = await asyncio.get_event_loop().run_in_executor(None, self.node.mempool.check_tx, tx)
         return {
             "code": res.code,
@@ -530,6 +697,7 @@ class RPCServer:
         """CheckTx → wait for DeliverTx event (reference: rpc/core/mempool.go)."""
         tx = self._decode_tx_param(params)
         tx_hash = tmhash.sum256(tx)
+        self._track_received(tx_hash)
         q = Query(f"{TX_HASH_KEY} = '{tx_hash.hex().upper()}'")
         subscriber = f"btc-{tx_hash.hex()[:16]}"
         sub = self.node.event_bus.subscribe(subscriber, q)
@@ -1095,6 +1263,12 @@ class RPCServer:
         ("/debug/light", "light-client-as-a-service snapshot: trusted span, "
          "cache/single-flight counters, coalesced flushes, sheds, "
          "conflicting-header detections", False),
+        ("/debug/tx_trace", "tx lifecycle observatory: ?hash= returns the "
+         "full received→delivered waterfall with per-stage durations; "
+         "without, ring stats + per-stage latency percentiles", False),
+        ("/debug/rpc", "per-method RPC latency attribution: gate state, "
+         "per-method outcome counts + mean/max, top-N slowest requests "
+         "with structured annotations", False),
         ("/debug/device_profile", "on-demand jax profiler capture; "
          "?action=start|stop|status (start/stop need rpc.unsafe)", True),
         ("/metrics", "Prometheus exposition (needs instrumentation."
@@ -1218,6 +1392,104 @@ class RPCServer:
         if svc is None:
             return {"enabled": False}
         return svc.stats()
+
+    # -- transaction & request observatory (libs/txtrace.py) ----------------
+
+    async def _tx_status(self, params) -> dict:
+        """Where is my transaction? The full lifecycle waterfall for one tx
+        hash: received -> checked -> admitted -> first_gossiped ->
+        proposed -> committed -> delivered (or the terminal reject/evict/
+        expire), with wall timestamps and per-stage durations. Sheddable
+        like `tx` — a status poll must never starve the vote path. A
+        disabled tracker and an unknown hash are both structured answers,
+        never -32603 + a stack trace per routine poll."""
+        tt = getattr(self.node, "tx_tracker", None)
+        if tt is None:
+            return {
+                "enabled": False,
+                "found": False,
+                "reason": "tx lifecycle tracking is disabled "
+                          "(set instrumentation.txtrace_enabled = true)",
+            }
+        h = params.get("hash", "")
+        try:
+            if isinstance(h, str):
+                tx_hash = bytes.fromhex(h[2:] if h.startswith("0x") else h)
+            else:
+                tx_hash = bytes(h)
+        except (ValueError, TypeError) as e:
+            # malformed input is a structured -32602 on every transport,
+            # never a -32603 + stack trace
+            raise ErrBadRequest(f"invalid hash parameter: {e}") from e
+        wf = tt.waterfall(tx_hash)
+        if wf is None:
+            # the routine polling answer, not an error: clients poll this
+            # route for hashes that may never have reached this node (or
+            # whose journey aged out of the bounded ring)
+            return {
+                "hash": tx_hash.hex().upper(),
+                "found": False,
+                "reason": "not in the lifecycle ring (never received here, "
+                          "or the journey aged out)",
+                "ring_max_txs": tt.max_txs,
+            }
+        wf["found"] = True
+        # a committed journey gains the indexer's final word when available
+        indexer = getattr(self.node, "tx_indexer", None)
+        if indexer is not None and wf.get("terminal") == "delivered":
+            try:
+                res = indexer.get(tx_hash)
+            except Exception:
+                res = None
+            if res is not None:
+                wf["indexed"] = {
+                    "height": str(res.height),
+                    "index": res.index,
+                    "code": res.code,
+                }
+        return wf
+
+    async def _debug_tx_trace(self, params) -> dict:
+        """GET /debug/tx_trace: with ?hash= the same waterfall as
+        `tx_status`; without, the tracker's ring stats — occupancy, lifetime
+        stage counts, terminal outcomes, and per-stage latency percentiles
+        (the document the chain observatory merges per node). Read-only,
+        served regardless of rpc.unsafe (like /debug/verify_stats)."""
+        tt = getattr(self.node, "tx_tracker", None)
+        if tt is None:
+            return {"enabled": False}
+        if params.get("hash"):
+            return await self._tx_status(params)
+        return tt.stats()
+
+    async def _debug_rpc(self, params) -> dict:
+        """GET /debug/rpc: per-method request attribution — the gate state,
+        per-method counts/outcomes/mean/max, and the bounded top-N
+        slowest-request ring with structured annotations (outcome, error,
+        gate pressure at dispatch). Read-only; the histogram form of the
+        same data rides /metrics as tendermint_rpc_request_duration_seconds."""
+        methods = {}
+        for label, agg in sorted(self._method_agg.items()):
+            served = agg["count"] - agg["shed"]  # latency covers served only
+            methods[label] = {
+                **agg,
+                "total_s": round(agg["total_s"], 6),
+                "mean_ms": round(agg["total_s"] / served * 1e3, 3)
+                if served
+                else 0.0,
+            }
+        return {
+            "gate": {
+                "max_inflight_requests": self.gate.max_inflight,
+                "inflight": self.gate.inflight,
+                "shed_total": self.gate.shed_total,
+                "shed_writes": self.gate.shed_writes,
+                "shed_reads": self.gate.shed_reads,
+            },
+            "methods": methods,
+            "slow_ring_cap": self.slow_ring.cap,
+            "slow_requests": self.slow_ring.snapshot(),
+        }
 
     async def _debug_device_profile(self, params) -> dict:
         """On-demand device profiler capture (libs/profiler.py over

@@ -53,8 +53,12 @@ class BlockExecutor:
         event_bus=None,
         block_store=None,
         metrics=None,
+        tx_tracker=None,
     ):
         self.metrics = metrics
+        # tx lifecycle tracker (libs/txtrace.py): the deliver path stamps
+        # each tracked tx's terminal `delivered(code)` stage
+        self.tx_tracker = tx_tracker
         self.state_store = state_store
         self.proxy_app = proxy_app
         self.mempool = mempool
@@ -218,6 +222,12 @@ class BlockExecutor:
             if res.code != abci.CODE_TYPE_OK:
                 invalid += 1
             deliver_txs.append(res)
+        tt = self.tx_tracker
+        if tt is not None and tt.enabled and block.txs:
+            # tracked journeys end here with the app's verdict; foreign txs
+            # (blocksync catch-up) were never `received` and are skipped
+            # inside record_delivered
+            tt.record_delivered(block.header.height, block.txs, deliver_txs)
         end = self.proxy_app.end_block(abci.RequestEndBlock(height=block.header.height))
         if invalid:
             logger.info("executed block with %d invalid txs", invalid)
@@ -331,6 +341,9 @@ def exec_commit_block(proxy_app: ABCIClient, block: Block, state: State, store=N
     ex = BlockExecutor.__new__(BlockExecutor)
     ex.proxy_app = proxy_app
     ex.mempool = _NullMempool()
+    # handshake replay re-delivers already-committed blocks; their journeys
+    # (if any) ended long ago — never re-stamp them
+    ex.tx_tracker = None
     responses = ex._exec_block_on_proxy_app(state, block)
     res = proxy_app.commit()
     del responses

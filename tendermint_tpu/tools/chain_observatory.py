@@ -94,6 +94,11 @@ def capture_node_dump(node) -> dict:
         doc["slo"] = eng.snapshot() if eng is not None else {"enabled": False}
     except Exception as e:
         doc["slo"] = {"error": repr(e)}
+    tt = getattr(node, "tx_tracker", None)
+    try:
+        doc["txtrace"] = tt.stats() if tt is not None else {"enabled": False}
+    except Exception as e:
+        doc["txtrace"] = {"error": repr(e)}
     try:
         from tendermint_tpu.libs import trace as _trace
 
@@ -172,6 +177,7 @@ async def scrape_node(base_url: str) -> dict:
         await call("verify_stats", "debug_verify_stats")
         await call("overload", "debug_overload")
         await call("mesh", "debug_mesh")
+        await call("txtrace", "debug_tx_trace")
         tl = doc.get("timeline") or {}
         if doc.get("node_id") is None:
             doc["node_id"] = tl.get("node_id")
@@ -428,6 +434,28 @@ def merge(dumps: List[dict], max_heights: Optional[int] = None) -> dict:
                 }
             )
 
+    # per-node tx lifecycle latency attribution (ISSUE 10): every node's
+    # per-stage percentiles + terminal-outcome counts, so a fleet report
+    # names WHICH node's txs stall at WHICH stage
+    tx_latency = []
+    tx_terminals: Dict[str, dict] = {}
+    for dump in dumps:
+        tx = dump.get("txtrace") or {}
+        label = _node_label(dump)
+        for stage, p in sorted((tx.get("stage_percentiles") or {}).items()):
+            tx_latency.append(
+                {
+                    "node": label,
+                    "stage": stage,
+                    "count": p.get("count"),
+                    "p50_ms": p.get("p50_ms"),
+                    "p99_ms": p.get("p99_ms"),
+                    "max_ms": p.get("max_ms"),
+                }
+            )
+        if tx.get("terminals"):
+            tx_terminals[label] = tx["terminals"]
+
     worst_offender = max(slow_counts.items(), key=lambda kv: kv[1])[0] if slow_counts else None
     return {
         "generated_ts": round(time.time(), 3),
@@ -438,6 +466,8 @@ def merge(dumps: List[dict], max_heights: Optional[int] = None) -> dict:
         "slo_any_tripped": any_tripped,
         "slowest_link_counts": slow_counts,
         "worst_offender": worst_offender,
+        "tx_latency": tx_latency,
+        "tx_terminals": tx_terminals,
     }
 
 
@@ -534,6 +564,24 @@ def render_markdown(report: dict) -> str:
             f"Habitual slowest link: **{report['worst_offender']}** "
             f"({report['slowest_link_counts'][report['worst_offender']]} heights)"
         )
+    lines.append("")
+    lines.append("## Tx lifecycle latency (per node, per stage)")
+    lines.append("")
+    if report.get("tx_latency"):
+        lines.append("| node | stage | count | p50 ms | p99 ms | max ms |")
+        lines.append("|---|---|---|---|---|---|")
+        for e in report["tx_latency"]:
+            lines.append(
+                f"| {e['node']} | {e['stage']} | {_fmt(e['count'])} | "
+                f"{_fmt(e['p50_ms'])} | {_fmt(e['p99_ms'])} | "
+                f"{_fmt(e['max_ms'])} |"
+            )
+        for label, terms in sorted((report.get("tx_terminals") or {}).items()):
+            pretty = ", ".join(f"{k}={v}" for k, v in sorted(terms.items()))
+            lines.append("")
+            lines.append(f"{label} terminal outcomes: {pretty}")
+    else:
+        lines.append("no tx lifecycle data recorded (tracker off or idle)")
     lines.append("")
     lines.append("## SLO verdicts")
     lines.append("")

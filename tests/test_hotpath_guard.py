@@ -153,8 +153,14 @@ def test_live_height_budgets(tmp_path, defer):
     state = Handshaker(state_store, state, block_store, gen, event_bus).handshake(proxy)
     wal = WAL(str(tmp_path / "wal"), group_commit=cfg.wal_group_commit,
               group_commit_max_latency=cfg.wal_group_commit_max_latency)
+    # a LIVE tx lifecycle tracker rides along (ISSUE 10): with tracing
+    # enabled it must not move any vote-path counter budget below — the
+    # tracker never touches votes, and this pins that
+    from tendermint_tpu.libs.txtrace import TxTracker
+
     cs = ConsensusState(cfg, state, block_exec, block_store, mempool, evpool,
-                        wal, event_bus=event_bus, priv_validator=sorted_privs[0])
+                        wal, event_bus=event_bus, priv_validator=sorted_privs[0],
+                        tx_tracker=TxTracker())
 
     async def run():
         await cs.start()

@@ -184,6 +184,10 @@ METRICS_SETS = (
     # light/service.py (requests by outcome, cache hits, coalesced lanes
     # per flush, sheds, conflicting-header detections)
     M.LightServiceMetrics,
+    # transaction & request observatory (ISSUE 10): tendermint_tx_* fed by
+    # libs/txtrace.py (stage latencies + terminal outcomes), plus the
+    # per-method tendermint_rpc_request_* series which ride RPCMetrics above
+    M.TxLifecycleMetrics,
 )
 
 

@@ -1,0 +1,617 @@
+"""Transaction & request observatory (ISSUE 10): libs/txtrace.py's journey
+ring, the mempool/consensus/deliver hooks, the tx_status / /debug/tx_trace /
+/debug/rpc serving surface, per-method RPC telemetry, and the light
+service's per-request stage spans.
+
+The acceptance proofs live in test_node_tx_status_waterfall_e2e: one
+broadcast_tx_sync through a real node yields a complete monotonic
+received→checked→admitted→proposed→committed→delivered waterfall, the new
+tendermint_tx_*/tendermint_rpc_request_* series are live on /metrics, and
+the tx_commit_latency / rpc_request_p99 SLO budgets are live on /debug/slo.
+Pure-host tests — no crypto wheel, no TPU, no p2p listener."""
+
+import asyncio
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import ABCIClient
+from tendermint_tpu.crypto import tmhash
+from tendermint_tpu.libs import metrics as M
+from tendermint_tpu.libs import trace
+from tendermint_tpu.libs.txtrace import STAGES, StageStats, TxTracker
+from tendermint_tpu.mempool.mempool import Mempool
+
+
+@pytest.fixture(autouse=True)
+def _tracing_on():
+    """The tracker follows the process-global tracer flag; pin it on (and
+    restore) so a prior test's configure() can't flake these."""
+    prev = trace.tracer.enabled
+    trace.tracer.enabled = True
+    yield
+    trace.tracer.enabled = prev
+
+
+def h(b: bytes) -> bytes:
+    return tmhash.sum256(b)
+
+
+# ---------------------------------------------------------------------------
+# the tracker itself
+
+
+def test_full_journey_waterfall_monotonic_with_durations():
+    tt = TxTracker(max_txs=64)
+    key = h(b"tx-1")
+    tt.record(key, "received", via="rpc")
+    tt.record(key, "checked", code=0, priority=3)
+    tt.record(key, "admitted", priority=3)
+    tt.record(key, "first_gossiped", peer="peer0")
+    tt.record(key, "proposed", height=5, round=0, index=0)
+    tt.record(key, "committed", height=5, round=0, index=0)
+    tt.record(key, "delivered", height=5, index=0, code=0)
+
+    wf = tt.waterfall(key)
+    assert wf is not None
+    assert [s["stage"] for s in wf["stages"]] == list(STAGES)
+    assert wf["terminal"] == "delivered" and wf["complete"] is True
+    # monotonic: offsets never decrease, durations never negative
+    offsets = [s["offset_ms"] for s in wf["stages"]]
+    assert offsets == sorted(offsets) and offsets[0] == 0.0
+    assert all(s["dur_ms"] >= 0.0 for s in wf["stages"])
+    assert wf["total_ms"] >= offsets[-1] - 1e-9
+    # attrs ride the stage entries
+    by_stage = {s["stage"]: s for s in wf["stages"]}
+    assert by_stage["received"]["via"] == "rpc"
+    assert by_stage["checked"]["code"] == 0
+    assert by_stage["committed"]["height"] == 5
+    assert by_stage["delivered"]["code"] == 0
+    # terminal + stage accounting
+    st = tt.stats()
+    assert st["terminals"] == {"delivered": 1}
+    assert st["stage_counts"]["received"] == 1
+    assert set(st["stage_percentiles"]) == set(STAGES)
+
+
+def test_non_ingress_stages_need_a_received_journey():
+    """Only txs first seen at ingress are tracked: a blocksync replay's
+    foreign commits must not flush the ring."""
+    tt = TxTracker(max_txs=64)
+    assert tt.record(h(b"foreign"), "committed", height=9, round=0) is False
+    assert tt.waterfall(h(b"foreign")) is None
+    assert tt.stats()["tracked"] == 0
+
+
+def test_disabled_tracer_records_nothing():
+    tt = TxTracker(max_txs=64)
+    trace.tracer.enabled = False
+    assert tt.enabled is False
+    assert tt.record(h(b"x"), "received", via="rpc") is False
+    trace.tracer.enabled = True
+    assert tt.stats()["tracked"] == 0
+
+
+def test_duplicate_stage_first_wins_and_terminal_reset_reenters():
+    tt = TxTracker(max_txs=64)
+    key = h(b"retry")
+    tt.record(key, "received", via="gossip")
+    assert tt.record(key, "received", via="rpc") is False  # dup ignored
+    tt.record(key, "rejected", reason="full")
+    assert tt.waterfall(key)["terminal"] == "rejected"
+    # a terminal ENDS the journey: later non-ingress stages (e.g. this tx
+    # committed via a peer's block after local eviction) never overwrite
+    # the terminal or double-count the outcome counters
+    assert tt.record(key, "committed", height=9, round=0) is False
+    assert tt.record(key, "delivered", height=9, code=0) is False
+    assert tt.waterfall(key)["terminal"] == "rejected"
+    assert tt.stats()["terminals"].get("delivered") is None
+    # a resubmission after the terminal starts a FRESH journey
+    assert tt.record(key, "received", via="rpc") is True
+    wf = tt.waterfall(key)
+    assert wf["terminal"] is None
+    assert [s["stage"] for s in wf["stages"]] == ["received"]
+    assert wf["stages"][0]["via"] == "rpc"
+    # reason-qualified terminal accounting survived the reset
+    assert tt.stats()["terminals"]["rejected:full"] == 1
+
+
+def test_ring_bounded_oldest_evicted_under_10k_flood():
+    cap = 256
+    tt = TxTracker(max_txs=cap, metrics=M.TxLifecycleMetrics(M.Registry()))
+    n = 10_000
+    for i in range(n):
+        tt.record(h(b"flood-%d" % i), "received", via="rpc")
+    st = tt.stats()
+    assert st["tracked"] == cap
+    assert st["ring_evictions"] == n - cap
+    # oldest gone, newest retained
+    assert tt.waterfall(h(b"flood-0")) is None
+    assert tt.waterfall(h(b"flood-%d" % (n - 1))) is not None
+    # a survivor's journey still extends normally
+    assert tt.record(h(b"flood-%d" % (n - 1)), "checked", code=0, priority=0)
+
+
+def test_stage_stats_percentiles_bounded():
+    ss = StageStats(maxlen=16)
+    for i in range(100):
+        ss.observe("s", i / 1000.0)
+    p = ss.percentiles()["s"]
+    assert p["count"] == 100  # lifetime count
+    assert p["max_ms"] == pytest.approx(99.0)
+    # percentiles cover only the newest maxlen samples (84..99 ms)
+    assert p["p50_ms"] >= 84.0
+
+
+# ---------------------------------------------------------------------------
+# mempool admission hooks (terminal states)
+
+
+class PrioApp(ABCIClient):
+    def check_tx(self, req):
+        tx = req.tx
+        prio = 0
+        if tx.startswith(b"p") and b":" in tx:
+            prio = int(tx[1 : tx.index(b":")])
+        code = abci.CODE_TYPE_OK if not tx.startswith(b"bad") else 1
+        return abci.ResponseCheckTx(code=code, priority=prio)
+
+
+def make_pool(**kw):
+    tt = TxTracker(max_txs=512)
+    defaults = dict(max_txs=3, tx_tracker=tt)
+    defaults.update(kw)
+    return Mempool(PrioApp(), **defaults), tt
+
+
+def test_mempool_admitted_checked_attrs():
+    mp, tt = make_pool()
+    mp.check_tx(b"p7:a")
+    wf = tt.waterfall(h(b"p7:a"))
+    stages = [s["stage"] for s in wf["stages"]]
+    assert stages == ["received", "checked", "admitted"]
+    by = {s["stage"]: s for s in wf["stages"]}
+    assert by["received"]["via"] == "rpc"
+    assert by["checked"]["priority"] == 7
+    assert by["admitted"]["priority"] == 7
+
+
+def test_mempool_eviction_records_terminal():
+    mp, tt = make_pool()
+    for tx in (b"p5:a", b"p1:b", b"p3:c"):
+        mp.check_tx(tx)
+    mp.check_tx(b"p4:d")  # evicts the p1 resident
+    wf = tt.waterfall(h(b"p1:b"))
+    assert wf["terminal"] == "evicted"
+    assert tt.stats()["terminals"]["evicted"] == 1
+
+
+def test_mempool_ttl_expiry_records_terminal():
+    mp, tt = make_pool(ttl_num_blocks=1)
+    mp.check_tx(b"p0:old")
+    mp.update(2, [], [])  # height jump past the TTL purges it
+    assert tt.waterfall(h(b"p0:old"))["terminal"] == "expired"
+    assert tt.stats()["terminals"]["expired"] == 1
+
+
+def test_mempool_quota_and_refusals_record_reasons():
+    mp, tt = make_pool(max_txs_per_sender=1)
+    mp.check_tx(b"p0:s1", sender="peerA")
+    mp.check_tx(b"p0:s2", sender="peerA")  # over quota, silent drop
+    assert tt.waterfall(h(b"p0:s2"))["terminal"] == "rejected"
+    assert tt.stats()["terminals"]["rejected:quota"] == 1
+    # gossip receipt is attributed to its channel
+    assert tt.waterfall(h(b"p0:s1"))["stages"][0]["via"] == "gossip"
+
+    # too_large (local submission raises; the journey still records)
+    mp2, tt2 = make_pool(max_tx_bytes=4)
+    with pytest.raises(Exception):
+        mp2.check_tx(b"way-too-large")
+    assert tt2.stats()["terminals"]["rejected:too_large"] == 1
+
+    # CheckTx failure
+    mp3, tt3 = make_pool()
+    mp3.check_tx(b"bad-tx")
+    wf = tt3.waterfall(h(b"bad-tx"))
+    assert wf["terminal"] == "rejected"
+    assert tt3.stats()["terminals"]["rejected:checktx"] == 1
+    assert {s["stage"] for s in wf["stages"]} == {"received", "checked", "rejected"}
+
+
+def test_mempool_full_no_eviction_records_full_reason():
+    mp, tt = make_pool(eviction=False)
+    for tx in (b"p0:a", b"p0:b", b"p0:c"):
+        mp.check_tx(tx)
+    mp.check_tx(b"p0:d", sender="peerB")  # silent gossip drop
+    assert tt.stats()["terminals"]["rejected:full"] == 1
+
+
+def test_resident_duplicate_submission_never_poisons_live_journey():
+    """A client retrying broadcast of a PENDING tx (the standard polling/
+    retry pattern) must not terminal the live journey as rejected:cache —
+    the tx is still on its way to a block."""
+    mp, tt = make_pool(max_txs=16)
+    mp.check_tx(b"p0:live")
+    key = h(b"p0:live")
+    assert tt.waterfall(key)["terminal"] is None
+    with pytest.raises(Exception):  # the submission IS refused...
+        mp.check_tx(b"p0:live")
+    wf = tt.waterfall(key)
+    assert wf["terminal"] is None  # ...but the journey stays live
+    assert tt.stats()["terminals"].get("rejected:cache") is None
+    # and it still extends to commit normally
+    assert tt.record(key, "proposed", height=2, round=0, index=0) is True
+
+
+def test_delivered_journey_survives_rebroadcast():
+    """Re-broadcasting a COMMITTED tx (cache blocks the replay) must keep
+    the delivered waterfall — tx_status answers 'delivered at height H',
+    never 'rejected:cache'."""
+    tt = TxTracker(max_txs=64)
+    key = h(b"done")
+    tt.record(key, "received", via="rpc")
+    tt.record(key, "delivered", height=3, code=0)
+    # the re-broadcast's ingress stamp does NOT reset a delivered journey
+    assert tt.record(key, "received", via="rpc") is False
+    # and the cache reject can't overwrite the terminal either
+    assert tt.record(key, "rejected", reason="cache") is False
+    wf = tt.waterfall(key)
+    assert wf["terminal"] == "delivered" and wf["complete"] is True
+
+
+def test_recheck_failure_records_terminal():
+    """A tx dropped on post-commit recheck (app flipped to non-OK) must not
+    read 'admitted' forever."""
+
+    class FlipApp(PrioApp):
+        def __init__(self):
+            self.flip = False
+
+        def check_tx(self, req):
+            if self.flip and req.type == abci.CHECK_TX_TYPE_RECHECK:
+                return abci.ResponseCheckTx(code=5)
+            return super().check_tx(req)
+
+    tt = TxTracker(max_txs=64)
+    app = FlipApp()
+    mp = Mempool(app, max_txs=16, tx_tracker=tt)
+    mp.check_tx(b"p0:re")
+    app.flip = True
+    mp.update(1, [], [])  # recheck now fails -> tx dropped
+    wf = tt.waterfall(h(b"p0:re"))
+    assert wf["terminal"] == "rejected"
+    assert tt.stats()["terminals"]["rejected:recheck"] == 1
+
+
+# ---------------------------------------------------------------------------
+# gossip fan-out hook (first_gossiped)
+
+
+def test_reactor_first_gossiped_on_successful_send():
+    import contextlib
+
+    from tendermint_tpu.mempool.reactor import MempoolReactor
+
+    mp, tt = make_pool(max_txs=16)
+    mp.check_tx(b"p0:gg")
+    reactor = MempoolReactor(mp)
+
+    class StubPeer:
+        id = "stub-peer-000000"
+        sent = 0
+
+        async def send(self, ch, data):
+            StubPeer.sent += 1
+            return True
+
+    async def drive():
+        # the walk loops forever once everything is sent; bound it
+        with contextlib.suppress(asyncio.TimeoutError):
+            await asyncio.wait_for(
+                reactor._broadcast_tx_routine(StubPeer()), timeout=0.2
+            )
+
+    asyncio.run(drive())
+    assert StubPeer.sent == 1
+    wf = tt.waterfall(h(b"p0:gg"))
+    assert wf["stages"][-1]["stage"] == "first_gossiped"
+    assert wf["stages"][-1]["peer"] == "stub-peer-"
+    # a second fan-out (another peer) never re-stamps the stage
+    key = h(b"p0:gg")
+    assert tt.record(key, "first_gossiped", peer="other-peer") is False
+
+
+# ---------------------------------------------------------------------------
+# per-method RPC telemetry (_dispatch + slow ring + /debug/rpc)
+
+
+def _make_rpc_server():
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.rpc.server import RPCServer
+
+    cfg = test_config()
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    nm = M.NodeMetrics()
+    node = SimpleNamespace(config=cfg, metrics=nm, slo=None, tx_tracker=None)
+    return RPCServer(node), nm
+
+
+def test_dispatch_observes_duration_outcome_and_folds_unknown_methods():
+    srv, nm = _make_rpc_server()
+
+    async def go():
+        # ok
+        await srv._dispatch("health", srv._routes["health"], {})
+
+        # error
+        async def boom(params):
+            raise RuntimeError("kaboom")
+
+        with pytest.raises(RuntimeError):
+            await srv._dispatch("tx", boom, {})
+        # shed: gate full for a sheddable method
+        from tendermint_tpu.rpc.server import RPCShedError
+
+        srv.gate.max_inflight = 1
+        srv.gate.inflight = 1
+        with pytest.raises(RPCShedError):
+            await srv._dispatch("broadcast_tx_sync", boom, {})
+        srv.gate.inflight = 0
+        # unknown method name folds into _other (bounded cardinality)
+        async def ok(params):
+            return {}
+
+        await srv._dispatch("made_up_method_xyz", ok, {})
+
+    asyncio.run(go())
+    counts = {k: v for k, v in nm.rpc.requests._values.items()}
+    assert counts[("health", "ok")] == 1
+    assert counts[("tx", "error")] == 1
+    assert counts[("broadcast_tx_sync", "shed")] == 1
+    assert counts[("_other", "ok")] == 1
+    # histogram series exist per method label, bounded to the route table
+    assert ("health",) in nm.rpc.request_duration._totals
+    assert ("_other",) in nm.rpc.request_duration._totals
+    assert not any(lbl == ("made_up_method_xyz",) for lbl in nm.rpc.request_duration._totals)
+    # the /debug/rpc aggregate mirrors it
+    doc = asyncio.run(srv._debug_rpc({}))
+    assert doc["methods"]["health"]["ok"] == 1
+    assert doc["methods"]["tx"]["error"] == 1
+    assert doc["gate"]["shed_total"] == 1
+
+
+def test_slow_ring_keeps_top_n_by_duration():
+    from tendermint_tpu.rpc.server import SlowRequestRing
+
+    ring = SlowRequestRing(cap=3)
+    for ms in (5, 1, 9, 3, 7, 2):
+        ring.offer(ms / 1e3, {"method": "m", "duration_ms": float(ms)})
+    snap = ring.snapshot()
+    assert [e["duration_ms"] for e in snap] == [9.0, 7.0, 5.0]
+
+
+def test_dispatch_feeds_slow_ring_with_annotations():
+    srv, _ = _make_rpc_server()
+
+    async def slowpoke(params):
+        await asyncio.sleep(0.005)
+        return {}
+
+    asyncio.run(srv._dispatch("abci_query", slowpoke, {}))
+    doc = asyncio.run(srv._debug_rpc({}))
+    assert doc["slow_requests"], "a 5ms request must enter the slow ring"
+    e = doc["slow_requests"][0]
+    assert e["method"] == "abci_query" and e["outcome"] == "ok"
+    assert e["duration_ms"] >= 5.0
+    assert {"inflight_at_dispatch", "shed_writes", "shed_reads", "error"} <= set(e)
+
+
+def test_rpc_request_p99_slo_fed_per_request():
+    from tendermint_tpu.config.config import SLOConfig
+    from tendermint_tpu.libs.slo import SLOEngine
+
+    srv, _ = _make_rpc_server()
+    srv.node.slo = SLOEngine(SLOConfig())
+    asyncio.run(srv._dispatch("health", srv._routes["health"], {}))
+    snap = srv.node.slo.snapshot()
+    assert snap["objectives"]["rpc_request_p99"]["observations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# node e2e: the acceptance proof
+
+
+def test_node_tx_status_waterfall_e2e(tmp_path, monkeypatch):
+    """broadcast_tx_sync → commit on a real single-validator node yields a
+    complete monotonic waterfall covering every single-node stage
+    (received→checked→admitted→proposed→committed→delivered; first_gossiped
+    needs a peer and is legitimately absent here), the new series are live
+    on /metrics, and both new SLO budgets are live on /debug/slo."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import gen_ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.rpc.client import LocalClient
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    monkeypatch.chdir(tmp_path)
+    cfg = test_config()
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = ""
+    cfg.root_dir = ""
+    priv = FilePV(gen_ed25519(b"\x10" * 32))
+    gen = GenesisDoc(
+        chain_id="txtrace-e2e",
+        validators=[GenesisValidator(priv.get_pub_key(), 10)],
+    )
+    node = Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
+    assert node.tx_tracker is not None
+
+    async def run():
+        await node.start()
+        client = LocalClient(node)
+        try:
+            await node.wait_for_height(1, timeout=30)
+            res = await client.call("broadcast_tx_sync", tx="0x" + b"k1=v1".hex())
+            assert res["code"] == 0
+            tx_hash = res["hash"]
+            deadline = time.monotonic() + 30
+            wf = None
+            while time.monotonic() < deadline:
+                try:
+                    wf = await client.call("tx_status", hash=tx_hash)
+                except Exception:
+                    wf = None
+                # wait for the async indexer too so `indexed` is attached
+                if (
+                    wf is not None
+                    and wf.get("terminal") == "delivered"
+                    and "indexed" in wf
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            assert wf is not None and wf["terminal"] == "delivered", wf
+            stages = [s["stage"] for s in wf["stages"]]
+            assert stages == [
+                "received", "checked", "admitted", "proposed",
+                "committed", "delivered",
+            ], stages
+            offsets = [s["offset_ms"] for s in wf["stages"]]
+            assert offsets == sorted(offsets)
+            assert all(s["dur_ms"] >= 0.0 for s in wf["stages"])
+            assert wf["complete"] is True
+            by = {s["stage"]: s for s in wf["stages"]}
+            assert by["received"]["via"] == "rpc"
+            assert by["committed"]["height"] >= 1
+            assert by["delivered"]["code"] == 0
+            assert wf["indexed"]["code"] == 0
+
+            # unknown hash: the routine polling answer, not a 500
+            nf = await client.call("tx_status", hash="ab" * 32)
+            assert nf["found"] is False and "reason" in nf
+            assert wf["found"] is True
+
+            # the hash-less debug doc: ring stats + stage percentiles
+            st = await client.call("debug_tx_trace")
+            assert st["tracked"] >= 1
+            assert st["terminals"].get("delivered", 0) >= 1
+            assert "committed" in st["stage_percentiles"]
+
+            # /debug/rpc attributes the requests this test just made
+            rpc_doc = await client.call("debug_rpc")
+            assert rpc_doc["methods"]["broadcast_tx_sync"]["count"] == 1
+            assert rpc_doc["methods"]["tx_status"]["count"] >= 1
+
+            # both new SLO budgets live on /debug/slo; tx_commit_latency has
+            # at least this tx's observation and holds its budget
+            slo_doc = await client.call("debug_slo")
+            assert {"tx_commit_latency", "rpc_request_p99"} <= set(
+                slo_doc["objectives"]
+            )
+            tcl = slo_doc["objectives"]["tx_commit_latency"]
+            assert tcl["observations"] >= 1 and tcl["breaches"] == 0
+
+            # the new series are on the /metrics exposition
+            text = node.metrics.expose()
+            assert 'tendermint_tx_stage_seconds_bucket{stage="committed"' in text
+            assert 'tendermint_tx_terminal_total{outcome="delivered"} ' in text
+            assert (
+                'tendermint_rpc_request_duration_seconds_bucket'
+                '{method="broadcast_tx_sync"' in text
+            )
+            assert 'tendermint_rpc_requests_total{method="tx_status", outcome="ok"}' in text
+
+            # the debug index advertises the new endpoints
+            idx = await client.call("debug_index")
+            paths = {e["path"] for e in idx["endpoints"]}
+            assert {"/debug/tx_trace", "/debug/rpc"} <= paths
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+
+def test_node_tx_status_unknown_hash_and_disabled_tracker(tmp_path, monkeypatch):
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import gen_ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.rpc.client import LocalClient
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    monkeypatch.chdir(tmp_path)
+    cfg = test_config()
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = ""
+    cfg.root_dir = ""
+    cfg.instrumentation.txtrace_enabled = False
+    priv = FilePV(gen_ed25519(b"\x11" * 32))
+    gen = GenesisDoc(
+        chain_id="txtrace-off",
+        validators=[GenesisValidator(priv.get_pub_key(), 10)],
+    )
+    node = Node(cfg, gen, priv_validator=priv, app=KVStoreApplication())
+    assert node.tx_tracker is None
+
+    async def run():
+        await node.start()
+        client = LocalClient(node)
+        try:
+            # disabled tracker: structured degrade on BOTH routes, not a
+            # -32603 + stack trace per routine poll
+            doc = await client.call("debug_tx_trace")
+            assert doc == {"enabled": False}
+            st = await client.call("tx_status", hash="ab" * 32)
+            assert st["enabled"] is False and st["found"] is False
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# light service per-request stage spans
+
+
+def test_light_service_stage_percentiles():
+    import test_light as lt
+
+    from tendermint_tpu.config.config import LightServiceConfig
+    from tendermint_tpu.light.provider import MockProvider
+    from tendermint_tpu.light.service import LightService
+
+    blocks = lt.make_chain(8)
+    svc = LightService(
+        lt.CHAIN_ID,
+        MockProvider(lt.CHAIN_ID, blocks),
+        LightServiceConfig(coalesce_window=0.01, max_heights_per_flush=16),
+        now_ns=lambda: lt.NOW,
+    )
+
+    async def go():
+        await asyncio.gather(*(svc.verify_height(hh) for hh in (3, 4, 5, 6)))
+        await svc.verify_height(3)  # a pure cache hit
+
+    try:
+        asyncio.run(go())
+        sp = svc.status()["stage_percentiles"]
+        # every request paid a cache probe; misses paid the window + the
+        # shared flush; at least one window fired
+        assert sp["cache_probe"]["count"] >= 5
+        assert sp["coalesce_wait"]["count"] >= 1
+        assert sp["flush_wall"]["count"] >= 1
+        assert sp["admission"]["count"] >= 1
+        assert sp["provider_fetch"]["count"] >= 1
+        for v in sp.values():
+            assert v["p50_ms"] >= 0.0 and v["p99_ms"] >= v["p50_ms"] - 1e-9
+        # the same doc rides GET /debug/light's stats()
+        assert "stage_percentiles" in svc.stats()
+    finally:
+        svc.close()
