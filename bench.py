@@ -53,6 +53,18 @@ Sub-benchmarks (in "extra", budget permitting):
                         cache/single-flight hit counts, and speedup =
                         serial per-request verification cost / coalesced
                         per-request cost
+  tx_admission        — device-batched CheckTx admission
+                        (docs/SCHEDULER.md): a live node + signed-tx flood
+                        through the scheduler's admission lane vs the
+                        app-side serial verify; reports admissions/s per
+                        arm, speedup (serial vs batched), admission flush
+                        sizes, and the vote-path flush-wall p99
+                        baseline-vs-flood (votes preempt: must stay flat)
+  multichip           — fused single-chip AND sharded multi-chip RLC over
+                        one batch (ROADMAP item 1): slope-methodology raw
+                        samples, per-shard mesh telemetry, sharded-vs-
+                        single speedup; 8 VIRTUAL devices on CPU-only
+                        hosts (marked virtual_devices)
 
 Scenario isolation (round 7): every scenario runs in its OWN subprocess
 with a per-stage watchdog inside and a hard process-group deadline outside.
@@ -1227,6 +1239,312 @@ def bench_light_serve(
     }
 
 
+def bench_multichip(n: int = 4096):
+    """ROADMAP item 1 leftover: fused single-chip AND sharded multi-chip RLC
+    numbers in ONE scenario, with slope-methodology raw samples and the
+    per-shard mesh telemetry (PR 7) attached — so a device round records
+    both datapoints in the perf ledger instead of MULTICHIP dryruns that
+    leave no benchmark. On a CPU-only host the mesh is 8 VIRTUAL devices
+    (XLA_FLAGS --xla_force_host_platform_device_count, set by the scenario
+    child env): the numbers are marked `virtual_devices` and prove the
+    plumbing, not the hardware."""
+    import jax
+
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.parallel import telemetry as mesh_tm
+
+    devices = jax.devices()
+    report = {
+        "n": n,
+        "devices_visible": len(devices),
+        "platform": devices[0].platform if devices else "none",
+        "virtual_devices": bool(devices) and devices[0].platform == "cpu",
+    }
+    pubkeys, msgs, sigs, _ = make_batch(n)
+
+    # -- fused single-chip: the production RLC path, slope methodology ------
+    os.environ["TMTPU_SHARDED"] = "0"
+    B._SHARDED_RUNNER = None
+    try:
+        log(f"[multichip] single-chip RLC over {n} sigs...")
+        rlc_first, rlc_best, rlc_prep = time_rlc(pubkeys, msgs, sigs)
+        single = {
+            "rlc_first_ms": round(rlc_first * 1e3, 3),
+            "rlc_e2e_ms": round(rlc_best * 1e3, 3),
+            "rlc_prep_ms": round(rlc_prep * 1e3, 3),
+            "fused": bool(B.LAST_FLUSH_DETAIL.get("fused")),
+        }
+        try:
+            samples, slope_ms = rlc_slope_samples(pubkeys, msgs, sigs)
+            single["slope_samples"] = samples
+            single["pipelined_slope_ms"] = round(slope_ms, 3)
+        except Exception as e:
+            log(f"[multichip] single-chip slope sampling FAILED: {e}")
+        report["single_chip"] = single
+
+        # -- sharded: the same combined check over the mesh -----------------
+        os.environ["TMTPU_SHARDED"] = "1"
+        B._SHARDED_RUNNER = None
+        env = B._sharded_env()
+        if env is None:
+            # no mesh: the sharded arm did NOT run — omit the ledger's
+            # `speedup` key entirely rather than fabricate parity
+            report["sharded"] = {"error": "no multi-device mesh available"}
+            return report
+        log(f"[multichip] sharded RLC over {env[0]} devices...")
+        t0 = time.perf_counter()
+        mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+        sharded_first = time.perf_counter() - t0
+        assert mask.all() and B.LAST_JAX_PATH[0] == "rlc-sharded", B.LAST_JAX_PATH[0]
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+            best = min(best, time.perf_counter() - t0)
+            assert mask.all()
+        report["sharded"] = {
+            "n_devices": env[0],
+            "first_ms": round(sharded_first * 1e3, 3),
+            "e2e_ms": round(best * 1e3, 3),
+            "path": B.LAST_JAX_PATH[0],
+            # per-shard evidence (PR 7): lanes, pad waste, all_gather bytes
+            "mesh_telemetry": mesh_tm.mesh_stats(),
+        }
+        # the ledger's matrix key: sharded speedup over the fused
+        # single-chip path on the SAME host (virtual CPU meshes typically
+        # read < 1x — the honest number for plumbing-only rounds)
+        report["speedup"] = round(rlc_best / best, 2)
+        report["sigs_per_sec_sharded"] = round(n / best)
+        return report
+    finally:
+        os.environ.pop("TMTPU_SHARDED", None)
+        B._SHARDED_RUNNER = None
+
+
+def bench_tx_admission(
+    flood_s: float = 8.0,
+    batch_txs: int = 256,
+    n_senders: int = 4,
+    n_keys: int = 16,
+):
+    """Device-batched tx admission (ISSUE 11, the headline workload of the
+    global verification scheduler): sustained tx-admissions/s under a
+    signed-tx flood with live consensus running concurrently.
+
+    Three phases on ONE live single-validator node running the
+    signed_kvstore app with deferred vote verification (so the vote path
+    rides the scheduler's VOTES lane):
+
+      baseline   no flood — the vote path's per-flush wall, unloaded;
+      serial     flood with sig_precheck OFF: every CheckTx pays the
+                 app-side serial host verify (the pre-scheduler path);
+      batched    flood with sig_precheck ON: envelopes batch-verify through
+                 the ADMISSION lane, the app consumes verdicts.
+
+    The flood is the gossip-reactor shape (check_tx_batch: one admission-
+    lane submit per batch) from `n_senders` threads. Reports admissions/s
+    per arm, their ratio as `speedup` (the perf-ledger matrix key), and the
+    vote-lane p99 flush wait baseline-vs-flood (must stay flat: votes
+    preempt)."""
+    import asyncio
+    import tempfile
+    import threading
+
+    from tendermint_tpu.abci.kvstore import SignedKVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import gen_ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.signed_tx import encode_signed_tx
+
+    import jax
+
+    cfg = test_config()
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = ""
+    cfg.root_dir = ""
+    cfg.consensus.wal_path = os.path.join(tempfile.mkdtemp(), "wal")
+    cfg.consensus.defer_vote_verification = True
+    cfg.mempool.size = 500_000
+    cfg.mempool.cache_size = 1_000_000
+    cfg.mempool.ttl_num_blocks = 2
+    # the scenario measures ADMISSION throughput; post-commit rechecks are
+    # their own (now also admission-lane-batched) axis and would otherwise
+    # re-verify the whole resident pool every committed block in BOTH arms
+    cfg.mempool.recheck = False
+    if jax.default_backend() == "cpu":
+        # XLA:CPU kernel compiles run MINUTES on small hosts; the host-RLC
+        # combined check (crypto/batch.verify_batch_cpu) is the honest fast
+        # path for this host class — and still an order of magnitude over
+        # the serial per-tx loop
+        cfg.scheduler.backend = "cpu"
+    app = SignedKVStoreApplication()
+    priv = FilePV(gen_ed25519(b"\x72" * 32))
+    gen = GenesisDoc(
+        chain_id="bench-tx-admission",
+        validators=[GenesisValidator(priv.get_pub_key(), 10)],
+    )
+    node = Node(cfg, gen, priv_validator=priv, app=app)
+    node._start_crypto_prewarm = lambda: None
+    sched = node.scheduler
+    assert sched is not None, "tx_admission needs [scheduler] enabled"
+
+    # pre-signed tx corpus (signing is milliseconds per tx on wheel-less
+    # hosts — it must not serialize the flood): n_keys signers, unique
+    # payloads per phase so the dedup cache never collapses the flood
+    log(f"[tx_admission] pre-signing tx corpus ({n_keys} keys)...")
+    keys = [gen_ed25519(bytes([k + 1]) * 32) for k in range(n_keys)]
+
+    def corpus(tag: str, count: int):
+        txs = [
+            encode_signed_tx(keys[i % n_keys], b"%s-%d=x" % (tag.encode(), i))
+            for i in range(count)
+        ]
+        return [txs[i : i + batch_txs] for i in range(0, len(txs), batch_txs)]
+
+    def vote_samples(t0: float, t1: float):
+        """votes-lane per-flush WALLS inside a window, off the scheduler's
+        flush journal — the vote path never queues (inline preemption), so
+        the wall (verify incl. any GIL/device contention with bulk flushes)
+        is the latency the vote path actually feels."""
+        # list() first: the dispatch thread appends concurrently, and a
+        # deque mutated mid-iteration raises (the snapshot is GIL-atomic)
+        return [
+            f["wall_s"]
+            for f in list(sched.flush_log)
+            if "votes" in f["rows"] and t0 <= f["t"] <= t1
+        ]
+
+    def flood(batches, stop_t):
+        admitted = 0
+        rejected = 0
+        lock = threading.Lock()
+        idx = {"i": 0}
+
+        def worker():
+            nonlocal admitted, rejected
+            while True:
+                with lock:
+                    i = idx["i"]
+                    idx["i"] += 1
+                if i >= len(batches) or time.monotonic() >= stop_t:
+                    return
+                out = node.mempool.check_tx_batch(batches[i], sender="bench-%d" % (i % n_senders))
+                ok = sum(1 for r in out if r is not None and r.code == 0)
+                with lock:
+                    admitted += ok
+                    rejected += len(out) - ok
+
+        threads = [threading.Thread(target=worker, daemon=True) for _ in range(n_senders)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return admitted, rejected, time.perf_counter() - t0
+
+    def pct(xs, p):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    async def run():
+        await node.start()
+        try:
+            await node.wait_for_height(2, timeout=120)
+            # -- baseline vote window (no flood) --
+            tb0 = time.monotonic()
+            h0 = node.block_store.height
+            await node.wait_for_height(h0 + 6, timeout=180)
+            tb1 = time.monotonic()
+            base_votes = vote_samples(tb0, tb1)
+
+            loop = asyncio.get_running_loop()
+            # -- serial arm: the app pays per-tx host verifies (corpus
+            # sized to the window: serial admits O(100s)/s) --
+            node.mempool.sig_precheck = False
+            batches = await loop.run_in_executor(None, corpus, "ser", 6_000)
+            stop_t = time.monotonic() + flood_s
+            serial = await loop.run_in_executor(
+                None, flood, batches, stop_t
+            )
+            # -- batched arm: admission lane + verdict consumption (an
+            # exhausted corpus just ends the arm early; rate = admitted/wall
+            # either way) --
+            node.mempool.sig_precheck = True
+            batches = await loop.run_in_executor(None, corpus, "bat", 30_000)
+            tf0 = time.monotonic()
+            stop_t = time.monotonic() + flood_s
+            batched = await loop.run_in_executor(
+                None, flood, batches, stop_t
+            )
+            tf1 = time.monotonic()
+            flood_votes = vote_samples(tf0, tf1)
+            return base_votes, serial, batched, flood_votes
+        finally:
+            await node.stop()
+
+    base_votes, serial, batched, flood_votes = asyncio.run(run())
+    s_adm, s_rej, s_wall = serial
+    b_adm, b_rej, b_wall = batched
+    serial_rate = s_adm / s_wall if s_wall else 0.0
+    batched_rate = b_adm / b_wall if b_wall else 0.0
+    base_p99 = pct(base_votes, 0.99)
+    flood_p99 = pct(flood_votes, 0.99)
+    adm_flushes = [f for f in list(sched.flush_log) if "admission" in f["rows"]]
+    out = {
+        "flood_s": flood_s,
+        "batch_txs": batch_txs,
+        "senders": n_senders,
+        "serial": {
+            "admitted": s_adm, "rejected": s_rej,
+            "admissions_per_sec": round(serial_rate, 1),
+            "app_serial_verifies": app.serial_verifies,
+        },
+        "batched": {
+            "admitted": b_adm, "rejected": b_rej,
+            "admissions_per_sec": round(batched_rate, 1),
+            "precheck_consumed": app.precheck_consumed,
+            "admission_flushes": len(adm_flushes),
+            "admission_rows_per_flush_max": max(
+                (f["rows"]["admission"] for f in adm_flushes), default=0
+            ),
+        },
+        "speedup": round(batched_rate / serial_rate, 2) if serial_rate else None,
+        "vote_path": {
+            "baseline_flushes": len(base_votes),
+            "flood_flushes": len(flood_votes),
+            "baseline_wall_p99_ms": round(base_p99 * 1e3, 3) if base_p99 is not None else None,
+            "flood_wall_p99_ms": round(flood_p99 * 1e3, 3) if flood_p99 is not None else None,
+            "p99_ratio": (
+                round(flood_p99 / base_p99, 2)
+                if base_p99 and flood_p99 is not None else None
+            ),
+            "preemptions": sched.preemptions,
+            # on pure-CPU hosts the admission flushes are host compute and
+            # contend with vote verification for the GIL; on a device
+            # backend the flush releases the host while the device works
+            "note": (
+                "cpu host: flood arm contends for the GIL"
+                if jax.default_backend() == "cpu" else "device backend"
+            ),
+        },
+        "scheduler": {
+            k: v for k, v in sched.stats().items()
+            if k in ("flushes", "preemptions", "inline_fallbacks", "lane_wait_percentiles")
+        },
+    }
+    log(
+        f"[tx_admission] serial {serial_rate:,.0f}/s vs batched "
+        f"{batched_rate:,.0f}/s ({out['speedup']}x); vote wall p99 "
+        f"{out['vote_path']['baseline_wall_p99_ms']} -> "
+        f"{out['vote_path']['flood_wall_p99_ms']} ms"
+    )
+    return out
+
+
 @contextlib.contextmanager
 def watchdog(seconds: float):
     """Abort a stage if it stalls: the device tunnel has been observed to
@@ -1309,6 +1627,8 @@ _SCENARIO_PLAN = [
     ("chaos_recovery", 90.0, 300.0),
     ("overload", 90.0, 400.0),
     ("light_serve", 60.0, 300.0),
+    ("tx_admission", 120.0, 500.0),
+    ("multichip", 240.0, 700.0),
     ("live_consensus", 240.0, 500.0),
 ]
 
@@ -1341,6 +1661,8 @@ def _scenario_fns() -> dict:
     fns["chaos_recovery"] = bench_chaos_recovery
     fns["overload"] = bench_overload
     fns["light_serve"] = bench_light_serve
+    fns["tx_admission"] = bench_tx_admission
+    fns["multichip"] = bench_multichip
     fns["live_consensus"] = bench_live_consensus
     # harness self-test scenarios (tests/test_bench_guard.py): cheap,
     # host-only, never in the default plan
@@ -1536,6 +1858,15 @@ def _run_scenario_child(name: str, deadline_s: float, degraded: bool = False,
 
     env = dict(os.environ, TMTPU_BENCH_SCENARIO=name)
     env["TMTPU_BENCH_SCENARIO_BUDGET_S"] = str(max(60, int(deadline_s - 90)))
+    if name == "multichip":
+        # the sharded arm needs a mesh: on hosts without 8 real chips, 8
+        # VIRTUAL CPU devices (flag only affects the CPU platform — a real
+        # TPU host's devices win). Must land BEFORE the child imports jax.
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     if stream_n is not None:
         env["TMTPU_BENCH_STREAM_N"] = str(stream_n)
     if degraded:

@@ -189,6 +189,66 @@ class KVStoreApplication(abci.Application):
         return abci.ResponseQuery(code=1, log=f"unknown path {req.path}")
 
 
+class SignedKVStoreApplication(KVStoreApplication):
+    """KVStore requiring a signed-tx envelope (types/signed_tx.py) on every
+    tx — the stub application behind device-batched CheckTx admission.
+
+    CheckTx is the ABCI split in action: when the node pre-verified the
+    envelope's signature through the scheduler's admission lane, the
+    request carries `sig_precheck` = OK|BAD and the app CONSUMES the
+    verdict; with no verdict (NONE — plain node, remote submitter,
+    precheck disabled) it verifies serially on the host, which is exactly
+    the per-tx loop the admission lane replaces (and the serial arm the
+    `tx_admission` bench measures).
+
+    DeliverTx unwraps the payload and applies it as a normal key=value tx.
+    It trusts CheckTx-gated admission and does not re-verify — fine for a
+    stub/bench app; a production app distrusting proposers would check
+    `sig_precheck` at DeliverTx too (the envelope rides in the block, so
+    anyone can)."""
+
+    CODE_BAD_ENVELOPE = 10
+    CODE_BAD_SIGNATURE = 11
+
+    def __init__(self, db: Optional[KVDB] = None, **kw):
+        super().__init__(db, **kw)
+        self.serial_verifies = 0  # host verifies paid (no precheck verdict)
+        self.precheck_consumed = 0  # verdicts consumed from the node
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        from tendermint_tpu.types import signed_tx as stx
+
+        env = stx.decode_signed_tx(req.tx)
+        if env is None:
+            return abci.ResponseCheckTx(
+                code=self.CODE_BAD_ENVELOPE, log="not a signed-tx envelope"
+            )
+        if req.sig_precheck == abci.SIG_PRECHECK_OK:
+            self.precheck_consumed += 1
+            ok = True
+        elif req.sig_precheck == abci.SIG_PRECHECK_BAD:
+            self.precheck_consumed += 1
+            ok = False
+        else:
+            self.serial_verifies += 1
+            ok = stx.verify_signed_tx(env)
+        if not ok:
+            return abci.ResponseCheckTx(
+                code=self.CODE_BAD_SIGNATURE, log="invalid tx signature"
+            )
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        from tendermint_tpu.types import signed_tx as stx
+
+        env = stx.decode_signed_tx(req.tx)
+        if env is None:
+            return abci.ResponseDeliverTx(
+                code=self.CODE_BAD_ENVELOPE, log="not a signed-tx envelope"
+            )
+        return super().deliver_tx(abci.RequestDeliverTx(tx=env.payload))
+
+
 class MerkleKVStoreApplication(KVStoreApplication):
     """KVStore whose app hash is the SimpleMap merkle root over its pairs,
     with `prove=true` queries answered by ValueOp proofs that chain to the

@@ -133,11 +133,23 @@ class ResponseBeginBlock:
 CHECK_TX_TYPE_NEW = 0
 CHECK_TX_TYPE_RECHECK = 1
 
+# Node-side signature-precheck verdict riding RequestCheckTx (the ABCI
+# split behind device-batched tx admission, crypto/scheduler.py): the node
+# decoded a signed-tx envelope (types/signed_tx.py) and batch-verified its
+# signature through the admission lane, so the app consumes the verdict
+# instead of paying a serial per-tx verify. NONE means the node did not
+# pre-verify (plain tx, precheck disabled, or a remote submitter) — the
+# app must verify itself exactly as before.
+SIG_PRECHECK_NONE = 0
+SIG_PRECHECK_OK = 1
+SIG_PRECHECK_BAD = 2
+
 
 @dataclass
 class RequestCheckTx:
     tx: bytes = b""
     type: int = CHECK_TX_TYPE_NEW
+    sig_precheck: int = SIG_PRECHECK_NONE
 
 
 @dataclass

@@ -202,7 +202,11 @@ SPECS: Dict[type, List[Tuple[int, str, str]]] = {
     a.RequestBeginBlock: [(1, "hash", "y"), (2, "header", "O"), (3, "last_commit_info", "L"),
                           (4, "byzantine_validators", "X")],
     a.ResponseBeginBlock: [(1, "events", "E")],
-    a.RequestCheckTx: [(1, "tx", "y"), (2, "type", "i")],
+    a.RequestCheckTx: [(1, "tx", "y"), (2, "type", "i"),
+                       # node-side signature-precheck verdict (ABCI split,
+                       # types.SIG_PRECHECK_*); proto3 zero-default = NONE,
+                       # so peers without the field interop unchanged
+                       (3, "sig_precheck", "i")],
     a.ResponseCheckTx: [(1, "code", "i"), (2, "data", "y"), (3, "log", "s"), (4, "info", "s"),
                         (5, "gas_wanted", "i"), (6, "gas_used", "i"), (7, "events", "E"),
                         (8, "codespace", "s")],

@@ -41,7 +41,8 @@ VERIFY_BATCH_BLOCKS = 16
 class BlocksyncReactor(Reactor):
     def __init__(self, state, block_exec, block_store, consensus_reactor=None,
                  active: bool = True, metrics=None,
-                 peer_timeout: float = None, retry_sleep: float = None):
+                 peer_timeout: float = None, retry_sleep: float = None,
+                 scheduler=None):
         super().__init__("BLOCKSYNC")
         self.state = state
         self.block_exec = block_exec
@@ -49,6 +50,11 @@ class BlocksyncReactor(Reactor):
         self.consensus_reactor = consensus_reactor
         self.active = active  # False = serve blocks only (we're not syncing)
         self.metrics = metrics  # BlockSyncMetrics or None
+        # global verification scheduler (crypto/scheduler.py): catch-up
+        # verification rides the CATCHUP lane — it soaks idle device
+        # capacity and yields to votes/light/admission (paused entirely at
+        # overload pressure level 2)
+        self.scheduler = scheduler
         # [fastsync] peer_timeout / retry_sleep (None = pool defaults)
         from tendermint_tpu.blocksync.pool import PEER_TIMEOUT, RETRY_SLEEP
 
@@ -194,7 +200,14 @@ class BlocksyncReactor(Reactor):
             return 0 if run else None
         # key_types: sr25519 validators' sigs must verify under sr25519 rules
         # (mirrors validator_set.py batched Verify*; liveness in mixed sets).
-        mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
+        if self.scheduler is not None and not self.scheduler.closed:
+            # catch-up lane: idle-soak scheduling + exact-mask recovery —
+            # verdicts byte-identical to the direct call below
+            mask = self.scheduler.verify_rows(
+                "catchup", pubkeys, msgs, sigs, key_types
+            )
+        else:
+            mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
         for i, (start, count, powers, total, ok_struct) in enumerate(spans):
             if not ok_struct:
                 return i
@@ -232,9 +245,14 @@ class BlocksyncReactor(Reactor):
                     continue
 
                 # batched verification across blocks x validators (the TPU
-                # showcase: one kernel launch for the whole run)
+                # showcase: one kernel launch for the whole run). Off-loop:
+                # the catch-up lane may hold these rows for its idle-soak
+                # window (or pause them under overload), and that wait must
+                # park an executor thread, never the shared event loop
                 _tv0 = time.perf_counter()
-                bad = self._verify_run_batched(run)
+                bad = await asyncio.get_running_loop().run_in_executor(
+                    None, self._verify_run_batched, run
+                )
                 if self.metrics is not None:
                     self.metrics.verify_seconds.observe(time.perf_counter() - _tv0)
                 n_ok = len(run) if bad is None else bad

@@ -636,6 +636,10 @@ def main(argv=None) -> int:
     sp.add_argument("--method", default="async", choices=("async", "sync"))
     sp.add_argument("--settle", type=float, default=2.0,
                     help="post-send wait before counting committed txs")
+    sp.add_argument("--signed", action="store_true",
+                    help="wrap every tx in a signed-tx envelope (one key "
+                         "per worker) — exercises device-batched CheckTx "
+                         "admission against a signed_kvstore app")
 
     sp = sub.add_parser(
         "abci", help="abci-cli console: drive an ABCI app (conformance tool)"
@@ -755,6 +759,7 @@ def main(argv=None) -> int:
                 tx_size=args.tx_size,
                 method=args.method,
                 settle=args.settle,
+                signed=args.signed,
             )
         )
         print(json.dumps(report))

@@ -239,6 +239,15 @@ class SLOConfig:
     # rpc/server.py's shared _dispatch; with target=0.99 this is the
     # serving path's p99 bound)
     rpc_request_p99: float = 1.0
+    # per-lane queue waits of the global verification scheduler
+    # (crypto/scheduler.py, fed once per combined flush): votes must land
+    # within thread-handoff time, light within its coalescing window plus
+    # slack, admission within its bounded-latency promise, catch-up within
+    # its idle-soak starvation floor
+    verify_lane_wait_votes: float = 0.05
+    verify_lane_wait_light: float = 0.1
+    verify_lane_wait_admission: float = 0.1
+    verify_lane_wait_catchup: float = 5.0
 
 
 @dataclass
@@ -272,6 +281,48 @@ class LightServiceConfig:
     trust_level_denominator: int = 3
     # clock drift tolerance (seconds) for header time checks
     max_clock_drift: float = 10.0
+
+
+@dataclass
+class SchedulerConfig:
+    """Global verification scheduler (crypto/scheduler.py; no reference
+    counterpart — the reference verifies serially at each call site).
+    Every verification consumer submits (pubkey, msg, sig) rows to one
+    node-wide scheduler with priority lanes: votes PREEMPT (flush
+    immediately, alone), light serves within its coalescing-window SLO,
+    admission (CheckTx prechecks) gets bounded latency, catch-up
+    (blocksync/evidence) soaks idle capacity. Budgets respond to the
+    overload controller: pressure level 1 shrinks admission/catch-up
+    (rows x pressure_rows_factor, waits x pressure_wait_factor), level 2
+    pauses catch-up entirely."""
+
+    enabled: bool = True
+    # crypto backend for the combined flushes ("" = crypto default)
+    backend: str = ""
+    # -- per-lane budgets: max rows taken per combined flush (0 = uncapped)
+    # and max seconds a queued row waits before its lane must flush --
+    votes_max_rows: int = 0        # votes are never capped or delayed
+    votes_max_wait: float = 0.0
+    light_max_rows: int = 8192
+    light_max_wait: float = 0.01   # the PR 9 coalescing-window SLO; the
+    #                                light service re-pins this from its
+    #                                [light_service] coalesce_window
+    admission_max_rows: int = 1024
+    admission_max_wait: float = 0.004
+    catchup_max_rows: int = 8192
+    catchup_max_wait: float = 0.25
+    # overload response (node/overload.py calls set_pressure)
+    pressure_rows_factor: float = 0.5
+    pressure_wait_factor: float = 2.0
+    # device-batched tx admission (the ABCI split): mempool CheckTx decodes
+    # signed-tx envelopes (types/signed_tx.py) and batch-verifies their
+    # signatures through the admission lane, passing the verdict to the app
+    # in RequestCheckTx.sig_precheck instead of the app paying a serial
+    # per-tx verify
+    admission_precheck: bool = True
+    # a consumer blocked on its verdict falls back to an inline host verify
+    # after this many seconds (the scheduler must never wedge a consumer)
+    wait_timeout: float = 30.0
 
 
 @dataclass
@@ -388,6 +439,7 @@ class Config:
     overload: OverloadConfig = field(default_factory=OverloadConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
     light_service: LightServiceConfig = field(default_factory=LightServiceConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)

@@ -178,12 +178,182 @@ def backend_default() -> str:
         return "cpu"
 
 
+# Host-side RLC (ISSUE 11): the same torsion-exact combined check the
+# device runs, evaluated with a pure-host Pippenger MSM. On wheel-less
+# CPU-backend hosts the serial loop pays ~milliseconds PER signature in the
+# pure-Python ladder; the combined check costs ~tens of point-adds per
+# signature, so large host flushes (the scheduler's admission lane, the
+# breaker's cpu degrade) go an order of magnitude faster. Exactness: the
+# coefficients are ≡ 0 (mod 8) (_sample_z), so every passing row's
+# cofactor-torsion defect is annihilated and an all-pass batch verifies the
+# combined equation EXACTLY; any failure falls back to the serial loop for
+# the exact per-row mask (same contract as the device RLC ladder).
+_HOST_RLC_MIN = int(os.environ.get("TMTPU_HOST_RLC_MIN", "48"))
+
+# decompressed-pubkey cache for the host path (the admission workload
+# re-verifies few distinct signers; consensus re-verifies one valset)
+_HOST_PT_CACHE: dict = {}
+_HOST_PT_CACHE_MAX = 8192
+
+
+def _host_point(pk: bytes):
+    """Cached ed25519_ref decompression (None = invalid encoding)."""
+    pt = _HOST_PT_CACHE.get(pk, False)
+    if pt is False:
+        from tendermint_tpu.crypto.ed25519_ref import point_decompress
+
+        pt = point_decompress(pk)
+        if len(_HOST_PT_CACHE) >= _HOST_PT_CACHE_MAX:
+            _HOST_PT_CACHE.clear()
+        _HOST_PT_CACHE[pk] = pt
+    return pt
+
+
+def _host_msm(pairs, window: int = 0):
+    """Σ s·P over ed25519_ref extended points — windowed bucket (Pippenger)
+    MSM, MSB-first with running doubles. `pairs`: [(point, scalar int)],
+    zero scalars skipped. window=0 picks the width minimizing the modeled
+    add count (bucket folds dominate small batches, digit adds large ones).
+    Returns the extended-coordinate sum (None = empty)."""
+    from tendermint_tpu.crypto.ed25519_ref import point_add, point_double
+
+    pairs = [(p, s) for p, s in pairs if s]
+    if not pairs:
+        return None
+    nbits = max(s.bit_length() for _, s in pairs)
+    if window <= 0:
+        n = len(pairs)
+        window = min(
+            range(3, 11),
+            key=lambda w: ((nbits + w - 1) // w) * (n + (1 << (w + 1))),
+        )
+    nwin = (nbits + window - 1) // window
+    nbuckets = (1 << window) - 1
+    acc = None
+    for w in range(nwin - 1, -1, -1):
+        if acc is not None:
+            for _ in range(window):
+                acc = point_double(acc)
+        shift = w * window
+        buckets = [None] * (nbuckets + 1)
+        for p, s in pairs:
+            d = (s >> shift) & nbuckets
+            if d:
+                buckets[d] = p if buckets[d] is None else point_add(buckets[d], p)
+        running = total = None
+        for b in range(nbuckets, 0, -1):
+            if buckets[b] is not None:
+                running = (
+                    buckets[b] if running is None
+                    else point_add(running, buckets[b])
+                )
+            if running is not None:
+                total = running if total is None else point_add(total, running)
+        if total is not None:
+            acc = total if acc is None else point_add(acc, total)
+    return acc
+
+
+def _verify_batch_cpu_rlc(pubkeys, msgs, sigs) -> Optional[np.ndarray]:
+    """Host combined check: Σ w_i·A_i + ((L-u) mod L)·B + Σ z_i·R_i == O
+    with w_i = z_i·h_i mod 8L, u = Σ z_i·s_i mod L — the exact device-RLC
+    equation (_rlc_submit) on host points. Returns the mask when the
+    combined check passes; None = caller must fall back to the serial loop
+    (a row failed, or an exceptional addition produced Z == 0)."""
+    from tendermint_tpu.crypto.ed25519_ref import BASE, IDENTITY, P, point_equal
+
+    from tendermint_tpu import native
+
+    n = len(pubkeys)
+    if native.available():
+        # multithreaded C challenge hashing (the same fast helper the
+        # device paths use); scalars lift to Python ints only where
+        # precheck holds
+        precheck, _a_rows, _r_rows, s_rows, h_rows = _precheck_and_hash_fast(
+            pubkeys, msgs, sigs
+        )
+        from_bytes = int.from_bytes
+        s_ints = [
+            from_bytes(s_rows[i].tobytes(), "little") if precheck[i] else 0
+            for i in range(n)
+        ]
+        hk_ints = [
+            from_bytes(h_rows[i].tobytes(), "little") if precheck[i] else 0
+            for i in range(n)
+        ]
+    else:
+        precheck, _a_rows, _r_rows, s_ints, hk_ints = _precheck_and_hash(
+            pubkeys, msgs, sigs
+        )
+    a_pts = [None] * n
+    r_pts = [None] * n
+    for i in range(n):
+        if not precheck[i]:
+            continue
+        a = _host_point(bytes(pubkeys[i]))
+        r = _host_point(bytes(sigs[i])[:32])
+        if a is None or r is None:
+            precheck[i] = False
+            continue
+        a_pts[i] = a
+        r_pts[i] = r
+    if not precheck.any():
+        return precheck  # nothing verifiable: every verdict already False
+    rng = np.random.default_rng()  # OS-entropy seeded per call
+    zs = _sample_z(rng, n, precheck)
+    # A-lane coefficients collapse per DISTINCT pubkey (mod 8L is exact):
+    # the admission workload verifies many txs from few signers, and one
+    # combined lane per signer cuts the MSM's digit adds accordingly
+    a_coef: dict = {}
+    a_by_key: dict = {}
+    pairs = []
+    u = 0
+    for i in range(n):
+        if not precheck[i]:
+            continue
+        pkb = bytes(pubkeys[i])
+        a_coef[pkb] = (a_coef.get(pkb, 0) + zs[i] * hk_ints[i]) % L8
+        a_by_key[pkb] = a_pts[i]
+        pairs.append((r_pts[i], zs[i]))
+        u += zs[i] * s_ints[i]
+    pairs.extend((a_by_key[pkb], c) for pkb, c in a_coef.items())
+    pairs.append((BASE, (L - u % L) % L))
+    res = _host_msm(pairs)
+    if res is None:
+        res = IDENTITY
+    if res[2] % P == 0:
+        # exceptional unified addition on crafted torsion inputs — the
+        # device kernels read this as REJECT; here the serial loop decides
+        return None
+    if point_equal(res, IDENTITY):
+        return precheck
+    return None  # some row is bad: recover the exact mask serially
+
+
 def verify_batch_cpu(
     pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> np.ndarray:
-    from tendermint_tpu.crypto.keys import Ed25519PubKey
+    from tendermint_tpu.crypto.keys import Ed25519PubKey, cofactorless_mode
 
-    out = np.zeros(len(pubkeys), dtype=bool)
+    n = len(pubkeys)
+    if n >= _HOST_RLC_MIN and not cofactorless_mode():
+        # combined-check fast path (see _verify_batch_cpu_rlc); cofactorless
+        # (reference-exact interop) mode stays on the serial loop — its
+        # acceptance predicate is stricter than the cofactored equation the
+        # combined check proves
+        try:
+            mask = _verify_batch_cpu_rlc(pubkeys, msgs, sigs)
+        except Exception:
+            import logging
+
+            logging.getLogger("tendermint_tpu.crypto.batch").exception(
+                "host RLC failed; falling back to the serial loop"
+            )
+            mask = None
+        if mask is not None:
+            LAST_FLUSH_DETAIL["host_rlc"] = True
+            return mask
+    out = np.zeros(n, dtype=bool)
     for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
         try:
             out[i] = Ed25519PubKey(bytes(pk)).verify(bytes(msg), bytes(sig))
@@ -1125,6 +1295,23 @@ def _verify_batch_mixed_exact(
     return out
 
 
+# ---------------------------------------------------------------------------
+# Global verification scheduler hook (crypto/scheduler.py). When a consumer
+# thread sits inside `scheduler.lane_scope(...)`, verify_batch /
+# verify_batch_submit route their rows through the node-wide scheduler lane
+# instead of dispatching their own flush — one global read + None check on
+# every call when no scheduler is installed.
+
+_LANE_ROUTER = None
+
+
+def set_lane_router(router) -> None:
+    """Install the scheduler's row router: callable(pubkeys, msgs, sigs,
+    backend, key_types) -> mask | None (None = route normally)."""
+    global _LANE_ROUTER
+    _LANE_ROUTER = router
+
+
 class FlushAccumulator:
     """Cross-request flush accumulation (light/service.py): while installed
     on this thread via `accumulate_flushes()`, every `verify_batch_submit`
@@ -1269,6 +1456,12 @@ def verify_batch_submit(
         return BatchHandle(
             acc=acc, acc_range=acc.add(pubkeys, msgs, sigs, key_types)
         )
+    if _LANE_ROUTER is not None and len(pubkeys) > 0:
+        # scheduler lane scope (crypto/scheduler.py): the lane's combined
+        # flush IS the async overlap — the handle comes back resolved
+        mask = _LANE_ROUTER(pubkeys, msgs, sigs, backend, key_types)
+        if mask is not None:
+            return BatchHandle(mask=mask)
     be = backend or backend_default()
     mixed = key_types is not None and any(t != "ed25519" for t in key_types)
     eligible = (
@@ -1450,6 +1643,14 @@ def verify_batch(
         raise ValueError("pubkeys/msgs/sigs length mismatch")
     if len(pubkeys) == 0:
         return np.zeros(0, dtype=bool)
+    if _LANE_ROUTER is not None:
+        # scheduler lane scope (crypto/scheduler.py): these rows join the
+        # node-wide combined flush; the router returns None outside a scope
+        # (and for the scheduler's own dispatch flush), costing one global
+        # read + None check on the unrouted path
+        mask = _LANE_ROUTER(pubkeys, msgs, sigs, backend, key_types)
+        if mask is not None:
+            return mask
     tr = _trace.tracer if _trace.tracer.enabled else None  # single flag check
     LAST_FLUSH_DETAIL.clear()
     compile0 = _trace.compile_seconds_total()

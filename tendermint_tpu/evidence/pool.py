@@ -40,6 +40,15 @@ class EvidencePool:
         self.state_store = state_store
         self.block_store = block_store
         self._state: Optional[State] = None
+        # gossiped adds run on executor threads (evidence/reactor.py routes
+        # them off-loop so the catch-up-lane verify never parks the event
+        # loop) while update() runs on the loop at commit — the
+        # check-then-set in add_evidence must not interleave with the
+        # committed-marking, or just-committed evidence re-enters pending
+        # and gets proposed again (rejected by every honest peer)
+        import threading
+
+        self._mut_lock = threading.Lock()
 
     def set_state(self, state: State) -> None:
         self._state = state
@@ -72,6 +81,30 @@ class EvidencePool:
         age_ns = state.last_block_time_ns - time_ns
         return age_blocks > params.max_age_num_blocks and age_ns > params.max_age_duration_ns
 
+    @staticmethod
+    def _catchup_verifier():
+        """The global scheduler's catch-up lane as an evidence signature
+        verifier (crypto/scheduler.py) — but only OFF the event loop (the
+        evidence reactor's executor hop, replay threads): on the loop (live
+        block validation in state/execution.py) a catch-up-lane wait would
+        stall consensus, so those two signatures verify inline as before.
+        Returns None when inline is the right answer."""
+        import asyncio
+
+        try:
+            asyncio.get_running_loop()
+            return None  # event-loop caller: latency-critical, stay inline
+        except RuntimeError:
+            pass
+        from tendermint_tpu.crypto import scheduler as _scheduler
+
+        sched = _scheduler.default_scheduler()
+        if sched is None:
+            return None
+        return lambda pk, msgs, sigs, kt: sched.verify_rows(
+            "catchup", pk, msgs, sigs, kt
+        )
+
     def check_evidence(self, state: State, ev) -> None:
         """Verify evidence against a given state (used by block validation)."""
         if not isinstance(ev, DuplicateVoteEvidence):
@@ -89,7 +122,8 @@ class EvidencePool:
         _, val = vals.get_by_address(ev.address())
         if val is None:
             raise EvidenceError("validator in evidence is not in the validator set")
-        ev.verify(state.chain_id, val.pub_key)
+        ev.verify(state.chain_id, val.pub_key,
+                  batch_verifier=self._catchup_verifier())
         # power consistency (reference: evidence/verify.go)
         if ev.validator_power != val.voting_power:
             raise EvidenceError(
@@ -107,7 +141,12 @@ class EvidencePool:
         if self.is_pending(ev) or self.is_committed(ev):
             return
         self.check_evidence(self._state, ev)
-        self.db.set(_pending_key(ev), ev.encode())
+        with self._mut_lock:
+            # re-check under the mutation lock: a block committing this
+            # exact evidence may have landed while we verified it off-loop
+            if self.is_committed(ev):
+                return
+            self.db.set(_pending_key(ev), ev.encode())
 
     def add_evidence_from_consensus(self, ev, time_ns: int, val_set) -> None:
         """Evidence discovered locally by consensus (conflicting votes)
@@ -135,15 +174,20 @@ class EvidencePool:
                     raise EvidenceError(
                         "evidence validator is not in the conflict's validator set"
                     )
-                ev.verify(self._state.chain_id, val.pub_key)
-        self.db.set(_pending_key(ev), ev.encode())
+                ev.verify(self._state.chain_id, val.pub_key,
+                          batch_verifier=self._catchup_verifier())
+        with self._mut_lock:
+            if self.is_committed(ev):
+                return
+            self.db.set(_pending_key(ev), ev.encode())
 
     def update(self, state: State, committed_evidence) -> None:
         """Mark committed, drop expired (reference: evidence/pool.go:91)."""
         self._state = state
-        for ev in committed_evidence:
-            self.db.set(_committed_key(ev), b"\x01")
-            self.db.delete(_pending_key(ev))
+        with self._mut_lock:
+            for ev in committed_evidence:
+                self.db.set(_committed_key(ev), b"\x01")
+                self.db.delete(_pending_key(ev))
         # prune expired pending
         deletes = []
         for key, raw in self.db.iterate_prefix(b"EV:pending:"):

@@ -74,9 +74,16 @@ class EvidenceReactor(Reactor):
             return
         from tendermint_tpu.evidence.pool import EvidenceWindowError
 
+        import asyncio as _asyncio
+
+        loop = _asyncio.get_running_loop()
         for ev in evs:
             try:
-                self.evpool.add_evidence(ev)
+                # off-loop: gossiped evidence's signature checks ride the
+                # scheduler's catch-up lane (idle-soak; see
+                # EvidencePool._catchup_verifier), and that wait must park
+                # an executor thread, never the consensus event loop
+                await loop.run_in_executor(None, self.evpool.add_evidence, ev)
             except EvidenceWindowError as e:
                 # benign race: honest peers with lagging/leading state offer
                 # evidence outside OUR window — drop, never score

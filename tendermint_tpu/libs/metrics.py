@@ -845,6 +845,41 @@ class LightServiceMetrics:
         )
 
 
+class SchedulerMetrics:
+    """Global verification scheduler accounting (crypto/scheduler.py): the
+    tendermint_verify_lane_* series behind the QoS story — per-lane queue
+    depth, queue waits, rows per combined flush, and how often the vote
+    lane preempted queued bulk work. No reference counterpart — the
+    reference has no shared device to schedule."""
+
+    def __init__(self, reg: Registry):
+        ns = f"{NAMESPACE}_verify_lane"
+        self.lane_depth = reg.gauge(
+            f"{ns}_depth",
+            "Signature rows currently queued per scheduler lane "
+            "(votes/light/admission/catchup).",
+            ("lane",),
+        )
+        self.lane_wait = reg.histogram(
+            f"{ns}_wait_seconds",
+            "Seconds the oldest queued row of a lane waited before its "
+            "combined flush started (one sample per flush per lane).",
+            ("lane",),
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 1.0, 5.0),
+        )
+        self.lane_flush_rows = reg.histogram(
+            f"{ns}_flush_rows",
+            "Rows a lane contributed to each combined flush it rode.",
+            ("lane",),
+            buckets=(1, 8, 64, 256, 1024, 4096, 16384, 65536),
+        )
+        self.preemptions = reg.counter(
+            f"{ns}_preemptions_total",
+            "Vote-lane flushes dispatched while bulk-lane work was queued "
+            "(the queued work waited; the votes did not).",
+        )
+
+
 class TxLifecycleMetrics:
     """Transaction lifecycle accounting (libs/txtrace.py): per-stage
     transition latencies and terminal outcomes of the tx journey
@@ -959,6 +994,7 @@ class NodeMetrics:
         self.overload = OverloadMetrics(self.registry)
         self.slo = SLOMetrics(self.registry)
         self.light = LightServiceMetrics(self.registry)
+        self.scheduler = SchedulerMetrics(self.registry)
         self.txtrace = TxLifecycleMetrics(self.registry)
         NodeMetrics._latest = self
 

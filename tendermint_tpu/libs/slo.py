@@ -84,6 +84,33 @@ OBJECTIVES = {
         "wall seconds of one dispatched RPC request, any method "
         "(all transports + LocalClient)",
     ),
+    # ISSUE 11: per-lane queue-wait budgets for the global verification
+    # scheduler (crypto/scheduler.py), observed once per combined flush as
+    # the OLDEST queued row's wait in that lane — the burn-rate guard pages
+    # when a lane stops meeting its scheduling promise (votes preempt,
+    # light serves within its coalescing window, admission stays bounded,
+    # catch-up's floor still moves).
+    "verify_lane_wait_votes": (
+        "verify_lane_wait_votes",
+        "seconds a queued vote-lane row waited before its flush started "
+        "(votes preempt: this is thread-handoff, never bulk-work queueing)",
+    ),
+    "verify_lane_wait_light": (
+        "verify_lane_wait_light",
+        "seconds a queued light-lane row waited before its flush started "
+        "(the serving coalescing window as actually delivered)",
+    ),
+    "verify_lane_wait_admission": (
+        "verify_lane_wait_admission",
+        "seconds a queued admission-lane (CheckTx precheck) row waited "
+        "before its flush started",
+    ),
+    "verify_lane_wait_catchup": (
+        "verify_lane_wait_catchup",
+        "seconds a queued catch-up-lane (blocksync/evidence) row waited "
+        "before its flush started (idle-soak by design; the starvation "
+        "floor bounds it)",
+    ),
 }
 
 # ring bound per objective: at soak rates (~10 obs/s) this covers the slow

@@ -358,6 +358,17 @@ def verify_stats() -> dict:
         out["mesh"] = _mesh_tm.mesh_stats()
     except Exception:
         pass
+    try:
+        # the global verification scheduler's lane state (process-global
+        # default, last node wins): who is queued for the device and under
+        # what budgets — the QoS half of the flush totals above
+        from tendermint_tpu.crypto import scheduler as _scheduler
+
+        sched = _scheduler.default_scheduler()
+        if sched is not None:
+            out["scheduler"] = sched.stats()
+    except Exception:
+        pass
     return out
 
 

@@ -1,18 +1,28 @@
-"""Coalescing window for the light-verification service.
+"""Job batcher for the light-verification service.
 
 Thousands of light clients asking for (mostly Zipfian-distributed) heights
 must not each pay a device flush: the service answers repeat heights from
-its verified-header cache, and this module batches the MISSES. The first
-miss arms a window timer; every miss arriving within `window_s` joins the
-batch; at window close (or when the batch hits `max_jobs`) ALL jobs run in
-one worker-thread call that shares ONE device flush via
+its verified-header cache, and this module groups the MISSES into shared
+window bodies. Concurrently-parked submits (an asyncio.gather burst, a
+flood draining off the transport) join one batch: the first submit arms a
+next-tick callback, later submits in the same loop tick join, and
+`max_jobs` flushes a full batch early. ALL of a batch's jobs run in ONE
+worker-thread call that shares ONE lane submission via
 crypto/batch.accumulate_flushes.
+
+The WINDOW TIMING that used to live here (a per-window `window_s` timer
+arming on the first miss) moved into the global verification scheduler
+(crypto/scheduler.py): the light lane holds every batch's rows for the
+configured coalescing window, so batches fired ticks apart — and other
+consumers' rows — still merge into one combined device flush. Keeping a
+second timer here would just double the wait, so it was deleted
+(ISSUE 11); this class is now purely the job-grouping half.
 
 The engine is deliberately generic: `run_batch(jobs) -> (results, info)`
 is supplied by the service (light/service.py builds the submit phases of
-every job's commit checks under a FlushAccumulator and flushes once);
+every job's commit checks under a lane accumulator and flushes once);
 `results[i]` is `(ok, value)` — an exception value fails job i only, never
-the window. bench.py's `light_serve` scenario drives the same engine
+the batch. bench.py's `light_serve` scenario drives the same engine
 without a node.
 
 No reference counterpart: the reference light client is one client doing
@@ -40,22 +50,18 @@ class _Window:
 
 
 class Coalescer:
-    """Batches concurrently-submitted jobs into shared executor runs.
-
-    window_s=0 still coalesces: jobs submitted in the same event-loop tick
-    join one batch (the timer fires on the next loop iteration), which is
-    what a burst of already-parked requests looks like."""
+    """Batches concurrently-submitted jobs into shared executor runs:
+    same-loop-tick submits join one batch; the cross-tick coalescing wait
+    lives in the scheduler's light lane, not here."""
 
     def __init__(
         self,
         run_batch: Callable[[List[Any]], Tuple[List[Tuple[bool, Any]], dict]],
-        window_s: float = 0.01,
         max_jobs: int = 64,
     ):
         if max_jobs <= 0:
             raise ValueError("max_jobs must be positive")
         self.run_batch = run_batch
-        self.window_s = max(0.0, float(window_s))
         self.max_jobs = int(max_jobs)
         self._window: Optional[_Window] = None
         self._closed = False
@@ -69,7 +75,7 @@ class Coalescer:
     # -- submit ---------------------------------------------------------------
 
     async def submit(self, job) -> Any:
-        """Join the open window (arming one if none is open) and await this
+        """Join the open batch (arming one if none is open) and await this
         job's result; raises the job's own failure."""
         if self._closed:
             raise RuntimeError("coalescer is closed")
@@ -78,7 +84,9 @@ class Coalescer:
         if w is None or w.fired:
             w = _Window()
             self._window = w
-            w.timer = loop.call_later(self.window_s, self._fire, w)
+            # next-tick fire: every submit already parked on this loop
+            # iteration joins; the lane's coalescing window does the rest
+            w.timer = loop.call_later(0.0, self._fire, w)
         fut: asyncio.Future = loop.create_future()
         w.jobs.append(job)
         w.futures.append(fut)
@@ -137,9 +145,9 @@ class Coalescer:
     # -- teardown / stats -----------------------------------------------------
 
     def close(self) -> None:
-        """Cancel the open window (pending submitters get CancelledError)
+        """Cancel the open batch (pending submitters get CancelledError)
         and refuse further submits — a request landing in the node's
-        teardown gap must not arm a fresh window on a dying loop."""
+        teardown gap must not arm a fresh batch on a dying loop."""
         self._closed = True
         w = self._window
         self._window = None
@@ -153,7 +161,6 @@ class Coalescer:
 
     def stats(self) -> dict:
         return {
-            "window_s": self.window_s,
             "max_jobs": self.max_jobs,
             "windows_fired": self.windows_fired,
             "jobs_total": self.jobs_total,

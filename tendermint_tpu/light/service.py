@@ -11,11 +11,13 @@ turns ONE node into that verification server (ROADMAP item 3):
 - repeat heights are answered from a bounded verified-header cache
   (LightStore) with SINGLE-FLIGHT semantics: K concurrent requests for the
   same uncached height await one verification, not K;
-- distinct-height misses are COALESCED (light/coalescer.py): every miss in
-  a window submits its commit checks' (pubkey, msg, sig) rows through
-  `begin_verify_commit_light_trusting` / `begin_verify_commit_light` under
-  a `crypto/batch.FlushAccumulator`, and the whole window is verified in
-  ONE shared cross-height device flush;
+- distinct-height misses are COALESCED: same-tick misses group into one
+  window body (light/coalescer.py), every miss submits its commit checks'
+  (pubkey, msg, sig) rows through `begin_verify_commit_light_trusting` /
+  `begin_verify_commit_light` under the global verification scheduler's
+  LIGHT-lane accumulator (crypto/scheduler.py, ISSUE 11), and the lane
+  holds the rows for the coalescing window — so window bodies fired ticks
+  apart, and the node's other consumers, share ONE combined device flush;
 - heights the trusted valset can't vouch for (+1/3 overlap missing after a
   valset rotation) fall back to the bisection client (light/client.py),
   whose interim headers warm the same cache;
@@ -229,6 +231,8 @@ class LightService:
         slo=None,
         trust_level: Optional[Fraction] = None,
         now_ns: Optional[Callable[[], int]] = None,
+        scheduler=None,
+        own_scheduler_if_missing: bool = True,
     ):
         self.chain_id = chain_id
         self.provider = provider
@@ -236,6 +240,25 @@ class LightService:
         self.store = store or LightStore(MemDB())
         self.metrics = metrics  # libs/metrics.LightServiceMetrics or None
         self.slo = slo  # libs/slo.SLOEngine or None
+        # Global verification scheduler (crypto/scheduler.py, ISSUE 11):
+        # every window's commit-check rows ride the LIGHT lane, whose
+        # max_wait is pinned below to this service's coalesce_window — the
+        # PR 9 coalescing-window SLO now lives in ONE place, and light rows
+        # share combined flushes with the node's other consumers. A
+        # standalone service (tests, bench) owns a private scheduler; a
+        # node with `[scheduler] enabled = false` passes
+        # own_scheduler_if_missing=False and the service degrades to plain
+        # per-window-body FlushAccumulator flushes (same-tick coalescing
+        # only — the operator turned the lane engine off).
+        self._owns_scheduler = scheduler is None and own_scheduler_if_missing
+        if self._owns_scheduler:
+            from tendermint_tpu.crypto.scheduler import VerifyScheduler
+
+            scheduler = VerifyScheduler()
+        self.scheduler = scheduler
+        if scheduler is not None:
+            scheduler.set_lane_wait("light", float(config.coalesce_window))
+        self._seen_flush_seqs: set = set()  # device-flush dedupe (bounded)
         self.trust_level = trust_level or Fraction(
             getattr(config, "trust_level_numerator", 1),
             getattr(config, "trust_level_denominator", 3),
@@ -250,7 +273,6 @@ class LightService:
         self.max_pending = int(config.max_pending)
         self.coalescer = Coalescer(
             self._run_jobs,
-            window_s=float(config.coalesce_window),
             max_jobs=int(config.max_heights_per_flush),
         )
         self._inflight: Dict[int, asyncio.Future] = {}  # single-flight map
@@ -576,24 +598,32 @@ class LightService:
     # -- the coalesced window body (worker thread) ----------------------------
 
     def _run_jobs(self, jobs: List[_Job]):
-        """One coalescing window: submit every job's commit checks under a
-        FlushAccumulator, flush ONCE, then settle each job from its own
-        mask slice. Runs in the coalescer's worker thread."""
+        """One coalesced batch: submit every job's commit checks under the
+        scheduler's light-lane accumulator, flush ONCE (the rows join the
+        node-wide combined flush after at most the lane's coalescing
+        window), then settle each job from its own mask slice. Runs in the
+        coalescer's worker thread — the lane wait parks this thread, never
+        the event loop."""
         from tendermint_tpu.crypto import batch as _batch
 
         now_ns = self._now_ns()
         prepared: List = []
         t_flush = time.perf_counter()
-        with _batch.accumulate_flushes() as acc:
+        acc = (
+            self.scheduler.accumulate("light")
+            if self.scheduler is not None
+            else _batch.FlushAccumulator()
+        )
+        with _batch.accumulate_flushes(acc):
             for job in jobs:
                 try:
                     prepared.append(self._submit_job(job, now_ns))
                 except Exception as e:
                     prepared.append(e)
             lanes = acc.lanes
-        acc.flush()  # the one device flush for this window
-        # one sample per WINDOW (submit phases + the shared device flush):
-        # the wall every rider of this window shares
+        acc.flush()  # rides the light lane's shared device flush
+        # one sample per BATCH (submit phases + lane wait + the shared
+        # device flush): the wall every rider of this batch shares
         self._span("flush_wall", t_flush)
         results = []
         for job, fins in zip(jobs, prepared):
@@ -606,7 +636,21 @@ class LightService:
             except Exception as e:
                 results.append((False, e))
         with self._counter_lock:
-            self.flushes += acc.flush_count
+            # `flushes` counts DEVICE flushes our rows rode: batches that
+            # merged into one combined flush share a flush_seq and count
+            # once. A SET of seen seqs (bounded), not a max-seen watermark:
+            # concurrent window bodies riding different flushes can
+            # complete out of order. Plain accumulators (no scheduler) and
+            # inline fallbacks count their own flushes.
+            seq = getattr(acc, "flush_seq", None)
+            if seq is None:
+                if lanes:
+                    self.flushes += getattr(acc, "flush_count", 1)
+            elif seq not in self._seen_flush_seqs:
+                if len(self._seen_flush_seqs) > 4096:
+                    self._seen_flush_seqs.clear()
+                self._seen_flush_seqs.add(seq)
+                self.flushes += 1
             self.lanes_total += lanes
         if self.metrics is not None:
             self.metrics.coalesced_lanes.observe(lanes)
@@ -693,7 +737,9 @@ class LightService:
             },
             "cache_size": len(heights),
             "cache_blocks": self.cache_blocks,
-            "coalesce_window_s": self.coalescer.window_s,
+            # the coalescing window now lives in the scheduler's light lane
+            # (this service pins it from [light_service] coalesce_window)
+            "coalesce_window_s": float(self.config.coalesce_window),
             "max_heights_per_flush": self.coalescer.max_jobs,
             "max_pending": self.max_pending,
             "pending": self._pending,
@@ -725,3 +771,5 @@ class LightService:
 
     def close(self) -> None:
         self.coalescer.close()
+        if self._owns_scheduler and self.scheduler is not None:
+            self.scheduler.close()

@@ -112,12 +112,24 @@ class Mempool:
         eviction: bool = True,
         max_txs_per_sender: int = 0,
         tx_tracker=None,
+        scheduler=None,
+        sig_precheck: bool = False,
     ):
         self.metrics = metrics
         # tx lifecycle tracker (libs/txtrace.py): admission is where a tx's
         # journey forks — admitted, rejected{reason}, evicted, or expired.
         # Every hook below is gated on tracker.enabled (the tracer flag).
         self.tx_tracker = tx_tracker
+        # device-batched tx admission (crypto/scheduler.py, ISSUE 11): with
+        # sig_precheck on, signed-tx envelopes (types/signed_tx.py) are
+        # batch-verified through the scheduler's ADMISSION lane BEFORE the
+        # mempool lock, and the verdict rides RequestCheckTx.sig_precheck so
+        # the app consumes it instead of paying a serial per-tx verify. A
+        # flood of concurrent check_tx callers (RPC executor threads, the
+        # gossip reactor's batches) coalesces into shared device flushes.
+        self.scheduler = scheduler
+        self.sig_precheck = bool(sig_precheck) and scheduler is not None
+        self.prechecked_total = 0  # envelopes verified through the lane
         self._wal = None
         if wal_path:
             self.init_wal(wal_path)
@@ -272,6 +284,64 @@ class Mempool:
             return None
         raise exc
 
+    def _sig_precheck_batch(
+        self, txs: List[bytes], keys: Optional[List[bytes]] = None,
+        skip_cache_peek: bool = False,
+    ) -> List[int]:
+        """Batch-verify the signed-tx envelopes among `txs` through the
+        scheduler's admission lane; returns one abci.SIG_PRECHECK_* verdict
+        per tx. Runs OUTSIDE the mempool lock — concurrent callers block on
+        the lane, not on each other, and their rows share device flushes.
+
+        Skipped rows (verdict NONE, the app verifies itself): non-envelope
+        txs, oversized txs (rejected before the app anyway), and txs whose
+        hash is already cached (an unlocked peek — a duplicate must not pay
+        a device verify; the peek is advisory, a stale answer only costs or
+        saves the one verify, never correctness)."""
+        from tendermint_tpu.types.signed_tx import decode_signed_tx
+
+        verdicts = [abci.SIG_PRECHECK_NONE] * len(txs)
+        if not self.sig_precheck:
+            return verdicts
+        rows: List[tuple] = []
+        idxs: List[int] = []
+        for i, tx in enumerate(txs):
+            if len(tx) > self.max_tx_bytes:
+                continue
+            env = decode_signed_tx(tx)
+            if env is None:
+                continue
+            if not skip_cache_peek:
+                # advisory duplicate peek; the caller hands us the hash it
+                # already computed (ONE sum256 per tx on the whole path)
+                key = keys[i] if keys is not None else tmhash.sum256(tx)
+                if key in self._cache:
+                    continue
+            rows.append(env)
+            idxs.append(i)
+        if not rows:
+            return verdicts
+        try:
+            mask = self.scheduler.verify_rows(
+                "admission",
+                [e.pubkey for e in rows],
+                [e.sign_bytes for e in rows],
+                [e.signature for e in rows],
+            )
+        except Exception:
+            # a broken scheduler must never lose txs: NONE degrades to the
+            # app's own serial verify, exactly the pre-split behavior
+            import logging
+
+            logging.getLogger("tendermint_tpu.mempool").exception(
+                "admission-lane precheck failed; degrading to app-side verify"
+            )
+            return verdicts
+        self.prechecked_total += len(rows)
+        for i, ok in zip(idxs, mask):
+            verdicts[i] = abci.SIG_PRECHECK_OK if ok else abci.SIG_PRECHECK_BAD
+        return verdicts
+
     def check_tx(self, tx: bytes, sender: str = "") -> Optional[abci.ResponseCheckTx]:
         """(reference: mempool/clist_mempool.go:234 CheckTx + resCbFirstTime :404)
 
@@ -279,13 +349,62 @@ class Mempool:
         echo the tx back, reference: mempool/reactor.go:41-96). A tx already
         in the cache from a peer returns None instead of raising (the
         reference updates the sender list and drops it silently)."""
+        sig_verdict = abci.SIG_PRECHECK_NONE
+        key = b""
+        if self.sig_precheck:
+            key = tmhash.sum256(tx)
+            sig_verdict = self._sig_precheck_batch([tx], keys=[key])[0]
+        return self._check_tx_admit(tx, sender, sig_verdict, key)
+
+    def check_tx_batch(
+        self, txs: List[bytes], sender: str = ""
+    ) -> List[Optional[abci.ResponseCheckTx]]:
+        """Admit a gossiped batch: ONE admission-lane submit covers every
+        envelope's signature (the reactor's per-message path), then each tx
+        takes the normal locked admission. Rejections of gossiped txs are
+        silent per-tx (the reference's sender-list-and-move-on), so one bad
+        tx never drops its batchmates."""
+        keys: List[bytes] = []
+        if self.sig_precheck:
+            keys = [tmhash.sum256(tx) for tx in txs]
+        verdicts = self._sig_precheck_batch(txs, keys=keys or None)
+        out: List[Optional[abci.ResponseCheckTx]] = []
+        for i, (tx, v) in enumerate(zip(txs, verdicts)):
+            try:
+                out.append(self._check_tx_admit(
+                    tx, sender, v, keys[i] if keys else b""
+                ))
+            except MempoolError:
+                if not sender:
+                    raise
+                out.append(None)
+            except Exception:
+                # a transient app/ABCI failure on ONE gossiped tx must not
+                # drop its batchmates (local submissions still raise — the
+                # RPC caller needs the error)
+                if not sender:
+                    raise
+                import logging
+
+                logging.getLogger("tendermint_tpu.mempool").exception(
+                    "gossiped tx failed CheckTx; continuing with the batch"
+                )
+                out.append(None)
+        return out
+
+    def _check_tx_admit(
+        self, tx: bytes, sender: str, sig_verdict: int, key: bytes = b""
+    ) -> Optional[abci.ResponseCheckTx]:
         with self._lock:
             tt = self._tt()
             # hash EARLY only when the tracker is live (the journey needs its
-            # key before the early rejects); disabled, the hot path hashes at
-            # the cache point exactly as before — a flood of oversized/
-            # over-quota txs costs no SHA-256 under the lock
-            key = tmhash.sum256(tx) if tt is not None else b""
+            # key before the early rejects) or the precheck path already
+            # computed it (passed in — never a second SHA-256 under the
+            # lock); otherwise the hot path hashes at the cache point
+            # exactly as before — a flood of oversized/over-quota txs costs
+            # no SHA-256 under the lock
+            if not key and tt is not None:
+                key = tmhash.sum256(tx)
             if tt is not None:
                 # journey ingress: dedupe inside the tracker (an RPC hook may
                 # have stamped it already; a re-gossip of a live journey is
@@ -315,7 +434,9 @@ class Mempool:
                     # must keep saying so (key=b"" skips the record)
                     return self._reject(TxInCacheError(), sender, b"")
                 return self._reject(TxInCacheError(), sender, key)
-            res = self.proxy_app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+            res = self.proxy_app.check_tx(abci.RequestCheckTx(
+                tx=tx, type=abci.CHECK_TX_TYPE_NEW, sig_precheck=sig_verdict
+            ))
             if tt is not None:
                 tt.record(key, "checked", code=res.code, priority=res.priority)
             if res.code == abci.CODE_TYPE_OK:
@@ -505,10 +626,26 @@ class Mempool:
 
     def _recheck_txs(self) -> None:
         tt = self._tt()
-        for key in list(self._txs.keys()):
-            mtx = self._txs[key]
+        keys = list(self._txs.keys())
+        # post-commit recheck is admission-shaped: with the scheduler wired,
+        # every resident envelope's signature re-verifies in ONE admission-
+        # lane batch (residents are cached by definition, so the duplicate
+        # peek is skipped) instead of a serial app-side verify per tx per
+        # block — the recheck loop was the last serial verify loop standing
+        verdicts = [abci.SIG_PRECHECK_NONE] * len(keys)
+        if self.sig_precheck and keys:
+            verdicts = self._sig_precheck_batch(
+                [self._txs[k].tx for k in keys], skip_cache_peek=True
+            )
+        for key, verdict in zip(keys, verdicts):
+            mtx = self._txs.get(key)
+            if mtx is None:
+                continue
             res = self.proxy_app.check_tx(
-                abci.RequestCheckTx(tx=mtx.tx, type=abci.CHECK_TX_TYPE_RECHECK)
+                abci.RequestCheckTx(
+                    tx=mtx.tx, type=abci.CHECK_TX_TYPE_RECHECK,
+                    sig_precheck=verdict,
+                )
             )
             if res.code != abci.CODE_TYPE_OK:
                 self._remove_tx(

@@ -91,14 +91,18 @@ class MempoolReactor(Reactor):
                 self.metrics.shed.labels("mempool_gossip").inc()
             return
         loop = asyncio.get_running_loop()
-        for tx in decode_txs(msg_bytes):
-            # check_tx holds the mempool lock and calls the app synchronously;
-            # run off-loop so a slow CheckTx can't stall all p2p/consensus I/O
-            # (same policy as the RPC broadcast path).
-            try:
-                await loop.run_in_executor(None, self.mempool.check_tx, tx, peer.id)
-            except Exception as e:
-                logger.debug("gossiped tx rejected: %s", e)
+        txs = decode_txs(msg_bytes)
+        # One executor hop for the WHOLE gossiped batch: check_tx_batch
+        # verifies every signed-tx envelope in ONE admission-lane submit
+        # (device-batched CheckTx, crypto/scheduler.py) before the per-tx
+        # locked admission — off-loop so a slow CheckTx can't stall all
+        # p2p/consensus I/O (same policy as the RPC broadcast path).
+        try:
+            await loop.run_in_executor(
+                None, self.mempool.check_tx_batch, txs, peer.id
+            )
+        except Exception as e:
+            logger.debug("gossiped tx batch rejected: %s", e)
 
     async def _broadcast_tx_routine(self, peer) -> None:
         """(reference: mempool/reactor.go:190 broadcastTxRoutine)"""

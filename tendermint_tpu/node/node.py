@@ -50,6 +50,10 @@ def default_app(name: str):
         return PersistentKVStoreApplication()
     if name == "counter":
         return CounterApplication()
+    if name == "signed_kvstore":
+        from tendermint_tpu.abci.kvstore import SignedKVStoreApplication
+
+        return SignedKVStoreApplication()
     raise ValueError(f"unknown in-proc app {name!r}")
 
 
@@ -135,6 +139,25 @@ class Node:
 
             self.slo = _slo.SLOEngine(config.slo, metrics=self.metrics.slo)
             _slo.set_default(self.slo)
+
+        # global verification scheduler (crypto/scheduler.py, ROADMAP item
+        # 2): the one device coordinator EVERY verification consumer submits
+        # to — votes preempt, light serves within its coalescing window,
+        # CheckTx admission batches, blocksync/evidence soak idle capacity.
+        # Node-local instance (its lanes carry this node's SLO + metrics),
+        # ALSO registered process-global (last node wins, the tracer model)
+        # for the deep consumers with no wiring path: types/vote_set.py and
+        # evidence/pool.py.
+        self.scheduler = None
+        if getattr(config, "scheduler", None) is not None and config.scheduler.enabled:
+            from tendermint_tpu.crypto import scheduler as _sched
+
+            self.scheduler = _sched.VerifyScheduler(
+                config.scheduler,
+                metrics=self.metrics.scheduler,
+                slo=self.slo,
+            )
+            _sched.set_default(self.scheduler)
 
         # tx lifecycle tracker (libs/txtrace.py, ISSUE 10): the bounded
         # per-tx journey ring behind tx_status / GET /debug/tx_trace.
@@ -228,6 +251,13 @@ class Node:
             eviction=config.mempool.eviction,
             max_txs_per_sender=config.mempool.max_txs_per_sender,
             tx_tracker=self.tx_tracker,
+            # device-batched tx admission (crypto/scheduler.py admission
+            # lane + the RequestCheckTx.sig_precheck ABCI split)
+            scheduler=self.scheduler,
+            sig_precheck=(
+                self.scheduler is not None
+                and config.scheduler.admission_precheck
+            ),
         )
 
         # evidence pool
@@ -295,6 +325,11 @@ class Node:
                 config.light_service,
                 metrics=self.metrics.light,
                 slo=self.slo,
+                scheduler=self.scheduler,
+                # [scheduler] enabled=false means NO lane engine anywhere —
+                # the service must not spin up a private one behind the
+                # operator's back (it degrades to per-window-body flushes)
+                own_scheduler_if_missing=False,
             )
 
         # overload controller (node/overload.py): samples queue depths into
@@ -426,6 +461,7 @@ class Node:
                 metrics=self.metrics.blocksync,
                 peer_timeout=config.fastsync.peer_timeout,
                 retry_sleep=config.fastsync.retry_sleep,
+                scheduler=self.scheduler,
             )
             self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
             from tendermint_tpu.statesync.reactor import StatesyncReactor
@@ -578,6 +614,14 @@ class Node:
         self._running = False
         if self.light_service is not None:
             self.light_service.close()
+        if self.scheduler is not None:
+            from tendermint_tpu.crypto import scheduler as _sched
+
+            # last-node-wins model: only deregister if still ours; close()
+            # drains queued work so no consumer blocks into its fallback
+            if _sched.default_scheduler() is self.scheduler:
+                _sched.set_default(None)
+            self.scheduler.close()
         await self.overload.stop()
         if self._statesync_task is not None:
             self._statesync_task.cancel()

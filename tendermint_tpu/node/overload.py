@@ -154,6 +154,13 @@ class OverloadController:
         evr = sw.reactors.get("EVIDENCE") if sw is not None else None
         if evr is not None:
             evr.shed = shed_gossip
+        # verification scheduler budgets (crypto/scheduler.py): level 1
+        # shrinks the admission/catch-up lanes, level 2 pauses catch-up —
+        # the device's bulk capacity yields to the vote path exactly when
+        # the node is drowning
+        sched = getattr(self.node, "scheduler", None)
+        if sched is not None:
+            sched.set_pressure(self.level)
 
     def shed_state(self) -> Dict[str, bool]:
         return {

@@ -1158,6 +1158,11 @@ class RPCServer:
             # the serving subsystem's consumption of the pipeline above —
             # one stats read covers the device AND who it verified for
             out["light"] = svc.stats()
+        sched = getattr(self.node, "scheduler", None)
+        if sched is not None:
+            # THIS node's scheduler, not the process-global default another
+            # in-process node may have registered last
+            out["scheduler"] = sched.stats()
         return out
 
     async def _consensus_timeline(self, params) -> dict:

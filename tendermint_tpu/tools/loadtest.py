@@ -121,10 +121,16 @@ async def _worker(
     tx_size: int,
     method: str,
     tag: bytes,
+    priv=None,
 ) -> None:
     """One connection: sends at 1/interval tx/s until stop_at. Each tx is
     unique (tag + counter + random pad) so the mempool cache never dedups
-    the load away."""
+    the load away. With `priv` set (--signed), every tx is a signed-tx
+    envelope (types/signed_tx.py) under this worker's key — the workload
+    that exercises the node's device-batched CheckTx admission lane against
+    an app like signed_kvstore."""
+    if priv is not None:
+        from tendermint_tpu.types.signed_tx import encode_signed_tx
     i = 0
     next_send = time.perf_counter()
     while True:
@@ -140,6 +146,8 @@ async def _worker(
         # dedup run 2 to zero committed); pad with random to the target size
         body = tag + b"=%d;" % i + os.urandom(8)
         tx = body + os.urandom(max(0, tx_size - len(body)))
+        if priv is not None:
+            tx = encode_signed_tx(priv, tx)
         i += 1
         t0 = time.perf_counter()
         try:
@@ -164,6 +172,7 @@ async def run_load(
     tx_size: int = 64,
     method: str = "async",
     settle: float = 2.0,
+    signed: bool = False,
 ) -> dict:
     """Drive `rate` tx/s aggregate across endpoints for `duration` seconds,
     then wait `settle` seconds and count committed txs by scanning the
@@ -188,6 +197,11 @@ async def run_load(
         stats = [LoadStats() for _ in range(n_workers)]
         tasks = []
         w = 0
+        privs = []
+        if signed:
+            from tendermint_tpu.crypto.keys import gen_ed25519
+
+            privs = [gen_ed25519() for _ in range(n_workers)]
         for c in clients:
             for _ in range(max(1, connections)):
                 tasks.append(
@@ -195,6 +209,7 @@ async def run_load(
                         _worker(
                             c, stats[w], stop_at, interval, tx_size, method,
                             b"load-%s-%d" % (run_id, w),
+                            priv=privs[w] if signed else None,
                         )
                     )
                 )
@@ -217,13 +232,19 @@ async def run_load(
         run_prefix = b"load-%s-" % run_id
         committed = 0
         heights = list(range(h0 + 1, h1 + 1))
+        if signed:
+            from tendermint_tpu.types.signed_tx import decode_signed_tx
         for c0 in range(0, len(heights), 32):
             blocks = await asyncio.gather(
                 *(clients[0].block(height=h) for h in heights[c0 : c0 + 32])
             )
             for blk in blocks:
                 for tx_b64 in blk["block"]["data"]["txs"]:
-                    if base64.b64decode(tx_b64).startswith(run_prefix):
+                    raw = base64.b64decode(tx_b64)
+                    if signed:
+                        env = decode_signed_tx(raw)
+                        raw = env.payload if env is not None else raw
+                    if raw.startswith(run_prefix):
                         committed += 1
 
         sent = sum(s.sent for s in stats)
@@ -234,6 +255,7 @@ async def run_load(
             "connections_per_endpoint": max(1, connections),
             "method": method,
             "tx_size": tx_size,
+            "signed": signed,
             "target_rate": rate,
             "duration_s": round(send_wall, 2),
             "sent": sent,

@@ -56,8 +56,14 @@ class DuplicateVoteEvidence:
         if self.vote_a.block_id.key() >= self.vote_b.block_id.key():
             raise ValueError("duplicate votes in invalid order")
 
-    def verify(self, chain_id: str, pubkey: PubKey) -> None:
-        """(reference: evidence/verify.go VerifyDuplicateVote + types/evidence.go:189)"""
+    def verify(self, chain_id: str, pubkey: PubKey, batch_verifier=None) -> None:
+        """(reference: evidence/verify.go VerifyDuplicateVote + types/evidence.go:189)
+
+        batch_verifier: optional callable(pubkeys, msgs, sigs, key_types)
+        -> bool mask — the evidence pool passes the global scheduler's
+        catch-up lane here (crypto/scheduler.py) so gossiped evidence's two
+        signature checks ride a combined device flush instead of two
+        serial host verifies; None keeps the serial reference path."""
         a, b = self.vote_a, self.vote_b
         if a.height != b.height or a.round != b.round or a.type != b.type:
             raise ValueError("duplicate votes must have same H/R/S")
@@ -67,6 +73,20 @@ class DuplicateVoteEvidence:
             raise ValueError("duplicate votes must vote for different blocks")
         if pubkey.address() != a.validator_address:
             raise ValueError("address does not match pubkey")
+        if batch_verifier is not None:
+            pk = pubkey.bytes()
+            kt = pubkey.type_name()
+            mask = batch_verifier(
+                [pk, pk],
+                [a.sign_bytes(chain_id), b.sign_bytes(chain_id)],
+                [a.signature, b.signature],
+                [kt, kt],
+            )
+            if not mask[0]:
+                raise ValueError("verifying VoteA: invalid signature")
+            if not mask[1]:
+                raise ValueError("verifying VoteB: invalid signature")
+            return
         if not pubkey.verify(a.sign_bytes(chain_id), a.signature):
             raise ValueError("verifying VoteA: invalid signature")
         if not pubkey.verify(b.sign_bytes(chain_id), b.signature):

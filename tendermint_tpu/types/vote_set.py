@@ -237,7 +237,21 @@ class VoteSet:
         hs = hotstats.stats if hotstats.stats.enabled else None
         if hs is not None:
             t0 = hotstats.perf_counter()
-        mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
+        # Global verification scheduler (crypto/scheduler.py): the deferred
+        # vote flush rides the VOTES lane — it PREEMPTS queued bulk work
+        # (light/admission/catch-up rows never inflate a vote flush's wall)
+        # and its verdicts are byte-identical to the direct call (the
+        # combined flush recovers the exact per-row mask). Process-global
+        # default (last node wins, the tracer model): VoteSet has no wiring
+        # path from the Node; with no scheduler the direct path is
+        # unchanged.
+        from tendermint_tpu.crypto import scheduler as _scheduler
+
+        sched = _scheduler.default_scheduler()
+        if sched is not None:
+            mask = sched.verify_rows("votes", pubkeys, msgs, sigs, key_types)
+        else:
+            mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
         if hs is not None:
             hs.add("verify", hotstats.perf_counter() - t0, n=len(pubkeys))
         committed = []

@@ -312,3 +312,39 @@ def test_guarded_main_emits_fallback_on_dead_child(tmp_path, monkeypatch):
     rep = json.loads(buf.getvalue())
     assert rep["value"] == -1
     assert "rc=3" in rep["extra"]["error"]
+
+
+def test_multichip_scenario_shape(monkeypatch):
+    """ISSUE 11 satellite: the `multichip` scenario wires the fused
+    single-chip AND sharded arms into one report (internals stubbed — the
+    real kernels are device-round work; this pins the plumbing: slope
+    samples attached, mesh telemetry attached, the ledger's `speedup`
+    key present)."""
+    import numpy as np
+
+    bench = _bench()
+    from tendermint_tpu.crypto import batch as B
+
+    monkeypatch.setattr(bench, "time_rlc", lambda *a, **k: (0.5, 0.2, 0.01))
+    monkeypatch.setattr(
+        bench, "rlc_slope_samples", lambda *a, **k: ([[1, 0.1], [2, 0.2]], 100.0)
+    )
+    monkeypatch.setattr(
+        bench, "make_batch",
+        lambda n, **k: ([b"\x01" * 32] * n, [b"m"] * n, [b"\x02" * 64] * n,
+                        ["ed25519"] * n),
+    )
+    monkeypatch.setattr(
+        B, "verify_batch_jax",
+        lambda pk, ms, sg: np.ones(len(pk), dtype=bool),
+    )
+    monkeypatch.setattr(B, "_sharded_env", lambda: (8, None, None))
+    B.LAST_JAX_PATH[0] = "rlc-sharded"
+    rep = bench.bench_multichip(n=64)
+    assert rep["single_chip"]["rlc_e2e_ms"] == 200.0
+    assert rep["single_chip"]["slope_samples"] == [[1, 0.1], [2, 0.2]]
+    assert rep["sharded"]["n_devices"] == 8
+    assert "mesh_telemetry" in rep["sharded"]
+    assert rep["speedup"] > 0  # single-vs-sharded ratio (stub arms)
+    assert rep["sigs_per_sec_sharded"] > 0
+    assert os.environ.get("TMTPU_SHARDED") is None  # env restored

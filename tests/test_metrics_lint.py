@@ -188,6 +188,10 @@ METRICS_SETS = (
     # libs/txtrace.py (stage latencies + terminal outcomes), plus the
     # per-method tendermint_rpc_request_* series which ride RPCMetrics above
     M.TxLifecycleMetrics,
+    # global verification scheduler (ISSUE 11): tendermint_verify_lane_*
+    # fed by crypto/scheduler.py (per-lane depth, queue waits, rows per
+    # combined flush, vote-lane preemptions)
+    M.SchedulerMetrics,
 )
 
 

@@ -476,6 +476,10 @@ def test_mempool_reactor_sheds_gossip_when_full_or_switched():
         def check_tx(self, tx, sender=""):
             self.checked.append(tx)
 
+        def check_tx_batch(self, txs, sender=""):
+            # the reactor's one-executor-hop batch path (ISSUE 11)
+            return [self.check_tx(tx, sender) for tx in txs]
+
         def entries(self):
             return []
 

@@ -1,0 +1,346 @@
+"""Global verification scheduler (crypto/scheduler.py, ISSUE 11): QoS lane
+semantics — votes preempt a full admission backlog, per-lane budgets respond
+to injected overload pressure, verdicts stay byte-identical to standalone
+verify_batch (including a corrupted row per lane), and a breaker-OPEN
+routes every lane to the CPU degrade path — plus the device-batched
+CheckTx admission split (mempool precheck -> RequestCheckTx.sig_precheck ->
+app consumes the verdict)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.config.config import SchedulerConfig
+from tendermint_tpu.crypto import batch as B
+from tendermint_tpu.crypto import scheduler as S
+from tendermint_tpu.crypto.keys import gen_ed25519
+
+
+def make_rows(n: int, tag: bytes = b"row", corrupt: int = -1):
+    """n (pubkey, msg, sig) triples; row `corrupt` (if >= 0) gets a
+    flipped signature byte."""
+    pk, ms, sg = [], [], []
+    for i in range(n):
+        priv = gen_ed25519(bytes([i % 250 + 1, i // 250]) + tag[:1] * 30)
+        m = tag + b"-%d" % i
+        pk.append(priv.pub_key().bytes())
+        ms.append(m)
+        s = bytearray(priv.sign(m))
+        if i == corrupt:
+            s[0] ^= 0xFF
+        sg.append(bytes(s))
+    return pk, ms, sg
+
+
+@pytest.fixture
+def sched():
+    s = S.VerifyScheduler(backend="cpu")
+    yield s
+    s.close()
+
+
+# -- lane semantics ------------------------------------------------------------
+
+
+def test_votes_preempt_full_admission_backlog(monkeypatch):
+    """10k queued admission rows must not inflate a vote flush: the vote
+    rows flush ALONE (no bulk rows ride along), ahead of the backlog, and
+    the preemption is counted."""
+    calls = []
+
+    def stub_verify(pubkeys, msgs, sigs, backend=None, key_types=None):
+        calls.append(len(pubkeys))
+        time.sleep(0.002)  # a visible flush wall without real crypto
+        return np.ones(len(pubkeys), dtype=bool)
+
+    monkeypatch.setattr(B, "verify_batch", stub_verify)
+    cfg = SchedulerConfig(admission_max_rows=512, admission_max_wait=10.0)
+    s = S.VerifyScheduler(cfg, backend="cpu")
+    try:
+        pk, ms, sg = [b"\x01" * 32] * 500, [b"m"] * 500, [b"\x02" * 64] * 500
+        bulk = [s.submit("admission", pk, ms, sg) for _ in range(20)]  # 10k rows
+        assert s.stats()["lanes"]["admission"]["depth_rows"] >= 9000
+        t0 = time.perf_counter()
+        mask = s.verify_rows("votes", pk[:32], ms[:32], sg[:32])
+        vote_wall = time.perf_counter() - t0
+        assert mask.all() and len(mask) == 32
+        # bounded: the vote flush waited for at most ONE in-flight bulk
+        # flush (<= 512 rows), never the 10k backlog
+        assert vote_wall < 1.0, f"vote flush took {vote_wall:.3f}s"
+        # the votes flush carried votes only
+        votes_flushes = [
+            f for f in list(s.flush_log) if "votes" in f["rows"]
+        ]
+        assert votes_flushes and all(
+            set(f["rows"]) == {"votes"} for f in votes_flushes
+        )
+        assert s.preemptions >= 1
+        # the backlog still drains, capped per flush by the lane budget
+        for t in bulk:
+            assert t.wait(30.0).all()
+        adm_flushes = [f for f in list(s.flush_log) if "admission" in f["rows"]]
+        assert adm_flushes
+        # entries are atomic (500-row submits), so a flush is at most one
+        # entry past the 512 budget
+        assert max(f["rows"]["admission"] for f in adm_flushes) <= 1000
+    finally:
+        s.close()
+
+
+def test_pressure_levels_shrink_budgets_and_pause_catchup(monkeypatch):
+    monkeypatch.setattr(
+        B, "verify_batch",
+        lambda pk, ms, sg, backend=None, key_types=None: np.ones(len(pk), dtype=bool),
+    )
+    cfg = SchedulerConfig(
+        admission_max_rows=400, admission_max_wait=0.01,
+        catchup_max_rows=400, catchup_max_wait=0.05,
+        pressure_rows_factor=0.5, pressure_wait_factor=2.0,
+    )
+    s = S.VerifyScheduler(cfg, backend="cpu")
+    try:
+        # level 0: base budgets
+        st = s.stats()["lanes"]["admission"]["budget"]
+        assert st["effective_max_rows"] == 400
+        # level 1: admission/catch-up shrink, votes/light untouched
+        s.set_pressure(1)
+        snap = s.stats()["lanes"]
+        assert snap["admission"]["budget"]["effective_max_rows"] == 200
+        assert snap["admission"]["budget"]["effective_max_wait_s"] == pytest.approx(0.02)
+        assert snap["catchup"]["budget"]["effective_max_rows"] == 200
+        assert snap["votes"]["budget"]["effective_max_rows"] == 0  # uncapped
+        assert not snap["catchup"]["paused"]
+        # shrunk budget actually caps flush composition
+        rows = [b"\x01" * 32] * 100, [b"m"] * 100, [b"\x02" * 64] * 100
+        bulk = [s.submit("admission", *rows) for _ in range(6)]  # 600 rows
+        for t in bulk:
+            t.wait(10.0)
+        adm = [f["rows"]["admission"] for f in list(s.flush_log) if "admission" in f["rows"]]
+        assert adm and max(adm) <= 300  # <= shrunk 200 + one atomic entry
+        # level 2: catch-up pauses entirely
+        s.set_pressure(2)
+        assert s.stats()["lanes"]["catchup"]["paused"]
+        parked = s.submit("catchup", *rows)
+        time.sleep(0.15)
+        assert not parked.done(), "catch-up must not flush at pressure level 2"
+        # back to normal: the parked work drains
+        s.set_pressure(0)
+        assert parked.wait(10.0).all()
+    finally:
+        s.close()
+
+
+def test_catchup_soaks_idle_capacity_only(monkeypatch):
+    """Catch-up rows wait while hotter lanes have work, then flush when the
+    device goes idle (or the starvation floor passes)."""
+    monkeypatch.setattr(
+        B, "verify_batch",
+        lambda pk, ms, sg, backend=None, key_types=None: np.ones(len(pk), dtype=bool),
+    )
+    cfg = SchedulerConfig(catchup_max_wait=0.05, admission_max_wait=0.02)
+    s = S.VerifyScheduler(cfg, backend="cpu")
+    try:
+        rows = [b"\x01" * 32] * 10, [b"m"] * 10, [b"\x02" * 64] * 10
+        cu = s.submit("catchup", *rows)
+        adm = s.submit("admission", *rows)
+        adm.wait(5.0)
+        cu.wait(5.0)
+        # the catch-up rows must not have ridden the admission flush
+        cu_flushes = [f for f in list(s.flush_log) if "catchup" in f["rows"]]
+        assert cu_flushes and all(
+            "admission" not in f["rows"] for f in cu_flushes
+        )
+    finally:
+        s.close()
+
+
+# -- verdict integrity ---------------------------------------------------------
+
+
+def test_verdicts_byte_identical_with_corrupted_row_per_lane(sched):
+    """Each lane's slice of the combined flush equals a standalone
+    verify_batch of that lane's rows — including one corrupted row per
+    lane, which must fail in ITS lane without touching the others."""
+    per_lane = {}
+    tickets = {}
+    for i, lane in enumerate(S.LANES):
+        pk, ms, sg = make_rows(6, tag=lane.encode(), corrupt=i % 6)
+        per_lane[lane] = (pk, ms, sg)
+        tickets[lane] = sched.submit(lane, pk, ms, sg)
+    for lane in S.LANES:
+        pk, ms, sg = per_lane[lane]
+        expect = B.verify_batch(pk, ms, sg, "cpu")
+        got = tickets[lane].wait(60.0)
+        assert got.dtype == expect.dtype and got.shape == expect.shape
+        assert (got == expect).all(), lane
+        assert not got.all() and got.sum() == 5  # exactly the corrupt row fails
+
+
+def test_lane_scope_routes_verify_batch(sched):
+    pk, ms, sg = make_rows(4, tag=b"scope", corrupt=1)
+    expect = B.verify_batch(pk, ms, sg, "cpu")
+    with sched.lane_scope("catchup"):
+        got = B.verify_batch(pk, ms, sg)
+    assert (got == expect).all()
+    assert sched.stats()["lanes"]["catchup"]["rows_total"] == 4
+    # outside the scope: no routing
+    B.verify_batch(pk, ms, sg, "cpu")
+    assert sched.stats()["lanes"]["catchup"]["rows_total"] == 4
+
+
+def test_lane_accumulator_slices_and_latches_errors(sched):
+    """The FlushAccumulator contract over a lane: per-submit slices of the
+    shared flush, and a failed flush re-raises for every later finish."""
+    pk, ms, sg = make_rows(6, tag=b"acc", corrupt=4)
+    acc = sched.accumulate("light")
+    with B.accumulate_flushes(acc):
+        h1 = B.verify_batch_submit(pk[:3], ms[:3], sg[:3])
+        h2 = B.verify_batch_submit(pk[3:], ms[3:], sg[3:])
+    m1 = B.verify_batch_finish(h1)
+    m2 = B.verify_batch_finish(h2)
+    assert (m1 == B.verify_batch(pk[:3], ms[:3], sg[:3], "cpu")).all()
+    assert (m2 == B.verify_batch(pk[3:], ms[3:], sg[3:], "cpu")).all()
+    assert acc.flush_seq is not None
+
+    boom = RuntimeError("flush died")
+
+    class Exploding(S.LaneAccumulator):
+        def flush(self):
+            if not self._flushed:
+                self._flushed = True
+                self._error = boom
+                raise boom
+            if self._error is not None:
+                raise self._error
+            return self._mask
+
+    acc2 = Exploding(sched, "light")
+    with B.accumulate_flushes(acc2):
+        h3 = B.verify_batch_submit(pk[:2], ms[:2], sg[:2])
+        h4 = B.verify_batch_submit(pk[2:4], ms[2:4], sg[2:4])
+    with pytest.raises(RuntimeError, match="flush died"):
+        B.verify_batch_finish(h3)
+    with pytest.raises(RuntimeError, match="flush died"):
+        B.verify_batch_finish(h4)
+
+
+def test_breaker_open_routes_every_lane_to_cpu_degrade():
+    """With the circuit breaker OPEN, a combined flush must do ZERO device
+    work on any lane — verify_batch's cpu-breaker path serves every
+    verdict, still byte-identical."""
+    from tendermint_tpu.crypto.circuit_breaker import VerifyCircuitBreaker
+    from tendermint_tpu.libs import trace
+
+    orig = B.BREAKER
+    breaker = VerifyCircuitBreaker(
+        probe=lambda: True, failure_threshold=1, spawn_probe_thread=False
+    )
+    breaker.record_failure("forced open for test")
+    assert not breaker.allow_device()
+    s = S.VerifyScheduler(backend="jax")  # explicit jax: the breaker gates it
+    try:
+        B.BREAKER = breaker
+        f0 = trace.verify_stats()["totals"].get("cpu/cpu-breaker", {}).get("flushes", 0)
+        tickets = {}
+        per_lane = {}
+        for lane in S.LANES:
+            pk, ms, sg = make_rows(4, tag=lane.encode(), corrupt=2)
+            per_lane[lane] = (pk, ms, sg)
+            tickets[lane] = s.submit(lane, pk, ms, sg)
+        for lane in S.LANES:
+            pk, ms, sg = per_lane[lane]
+            assert (tickets[lane].wait(60.0) == B.verify_batch_cpu(pk, ms, sg)).all()
+        f1 = trace.verify_stats()["totals"].get("cpu/cpu-breaker", {}).get("flushes", 0)
+        assert f1 > f0, "flushes must have taken the cpu-breaker path"
+    finally:
+        B.BREAKER = orig
+        s.close()
+
+
+# -- wiring --------------------------------------------------------------------
+
+
+def test_slo_lane_wait_feed():
+    from tendermint_tpu.config.config import SLOConfig
+    from tendermint_tpu.libs.slo import SLOEngine
+
+    eng = SLOEngine(SLOConfig(window_fast=10.0, window_slow=100.0))
+    s = S.VerifyScheduler(backend="cpu", slo=eng)
+    try:
+        pk, ms, sg = make_rows(3, tag=b"slo")
+        s.verify_rows("admission", pk, ms, sg)
+        snap = eng.evaluate()
+        assert snap["verify_lane_wait_admission"]["observations"] == 1
+    finally:
+        s.close()
+
+
+def test_default_scheduler_registration_and_verify_stats_block():
+    from tendermint_tpu.libs import trace
+
+    s = S.VerifyScheduler(backend="cpu")
+    S.set_default(s)
+    try:
+        assert S.default_scheduler() is s
+        pk, ms, sg = make_rows(3, tag=b"dflt")
+        s.verify_rows("votes", pk, ms, sg)
+        block = trace.verify_stats().get("scheduler")
+        assert block is not None and block["flushes"] >= 1
+        assert set(block["lanes"]) == set(S.LANES)
+    finally:
+        S.set_default(None)
+        s.close()
+    # a closed scheduler never reads as the default
+    S.set_default(s)
+    assert S.default_scheduler() is None
+    S.set_default(None)
+
+
+def test_closed_scheduler_falls_back_inline(sched):
+    sched.close()
+    pk, ms, sg = make_rows(3, tag=b"closed", corrupt=0)
+    mask = sched.verify_rows("admission", pk, ms, sg)
+    assert (mask == B.verify_batch(pk, ms, sg, "cpu")).all()
+    acc = sched.accumulate("light")
+    with B.accumulate_flushes(acc):
+        h = B.verify_batch_submit(pk, ms, sg)
+    assert (B.verify_batch_finish(h) == B.verify_batch(pk, ms, sg, "cpu")).all()
+
+
+def test_concurrent_submitters_share_flushes(monkeypatch):
+    """K threads submitting concurrently coalesce into far fewer combined
+    flushes than K (the admission-flood shape)."""
+    calls = []
+
+    def stub_verify(pubkeys, msgs, sigs, backend=None, key_types=None):
+        calls.append(len(pubkeys))
+        time.sleep(0.005)
+        return np.ones(len(pubkeys), dtype=bool)
+
+    monkeypatch.setattr(B, "verify_batch", stub_verify)
+    cfg = SchedulerConfig(admission_max_wait=0.01, admission_max_rows=4096)
+    s = S.VerifyScheduler(cfg, backend="cpu")
+    try:
+        K, done = 32, []
+        lock = threading.Lock()
+
+        def worker(i):
+            mask = s.verify_rows(
+                "admission", [b"\x01" * 32] * 4, [b"m%d" % i] * 4, [b"\x02" * 64] * 4
+            )
+            with lock:
+                done.append(mask.all())
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(K)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+        assert len(done) == K and all(done)
+        assert len(calls) < K / 2, f"{len(calls)} flushes for {K} submitters"
+    finally:
+        s.close()

@@ -1,0 +1,278 @@
+"""Device-batched CheckTx admission (ISSUE 11): the signed-tx envelope,
+the RequestCheckTx.sig_precheck ABCI split, the mempool's admission-lane
+precheck (single and gossip-batch paths), and the end-to-end proof that a
+signed flood admits through the scheduler with the app consuming verdicts
+instead of paying serial verifies."""
+
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci import wire
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.kvstore import SignedKVStoreApplication
+from tendermint_tpu.crypto.keys import gen_ed25519
+from tendermint_tpu.crypto.scheduler import VerifyScheduler
+from tendermint_tpu.mempool.mempool import Mempool, TxTooLargeError
+from tendermint_tpu.types import signed_tx as stx
+
+PRIV = gen_ed25519(b"\x2a" * 32)
+
+
+def make_mp(app=None, **kw):
+    app = app or SignedKVStoreApplication()
+    sched = VerifyScheduler(backend="cpu")
+    mp = Mempool(LocalClient(app), scheduler=sched, sig_precheck=True, **kw)
+    return mp, app, sched
+
+
+# -- envelope ------------------------------------------------------------------
+
+
+def test_signed_tx_roundtrip_and_tamper():
+    tx = stx.encode_signed_tx(PRIV, b"hello=world")
+    env = stx.decode_signed_tx(tx)
+    assert env is not None
+    assert env.pubkey == PRIV.pub_key().bytes()
+    assert env.payload == b"hello=world"
+    assert stx.verify_signed_tx(env)
+    # tampered payload fails (domain-separated sign bytes)
+    bad = stx.decode_signed_tx(tx[:-1] + b"!")
+    assert bad is not None and not stx.verify_signed_tx(bad)
+    # tampered signature fails
+    t2 = bytearray(tx)
+    t2[40] ^= 0xFF
+    assert not stx.verify_signed_tx(stx.decode_signed_tx(bytes(t2)))
+    # non-envelopes decode to None
+    assert stx.decode_signed_tx(b"plain=1") is None
+    assert stx.decode_signed_tx(b"") is None
+    assert stx.decode_signed_tx(stx.MAGIC + b"short") is None
+
+
+def test_signed_tx_signature_is_domain_separated():
+    """A signed-tx signature must not verify over the raw payload (and vice
+    versa) — the envelope can never replay a consensus signature."""
+    tx = stx.encode_signed_tx(PRIV, b"payload")
+    env = stx.decode_signed_tx(tx)
+    from tendermint_tpu.crypto.keys import Ed25519PubKey
+
+    assert not Ed25519PubKey(env.pubkey).verify(b"payload", env.signature)
+
+
+def test_sig_precheck_wire_roundtrip():
+    req = abci.RequestCheckTx(tx=b"abc", sig_precheck=abci.SIG_PRECHECK_BAD)
+    enc = wire.encode_msg(req)
+    dec = wire.decode_msg(abci.RequestCheckTx, enc)
+    assert dec.tx == b"abc" and dec.sig_precheck == abci.SIG_PRECHECK_BAD
+    # default NONE survives (proto3 zero default)
+    dec2 = wire.decode_msg(abci.RequestCheckTx,
+                           wire.encode_msg(abci.RequestCheckTx(tx=b"x")))
+    assert dec2.sig_precheck == abci.SIG_PRECHECK_NONE
+
+
+# -- mempool precheck ----------------------------------------------------------
+
+
+def test_precheck_verdict_consumed_by_app():
+    mp, app, sched = make_mp()
+    try:
+        res = mp.check_tx(stx.encode_signed_tx(PRIV, b"k=v"))
+        assert res.code == abci.CODE_TYPE_OK
+        assert app.precheck_consumed == 1 and app.serial_verifies == 0
+        assert mp.prechecked_total == 1
+        assert sched.stats()["lanes"]["admission"]["rows_total"] == 1
+    finally:
+        sched.close()
+
+
+def test_precheck_bad_signature_rejected_without_serial_verify():
+    mp, app, sched = make_mp()
+    try:
+        tx = bytearray(stx.encode_signed_tx(PRIV, b"k=v"))
+        tx[40] ^= 0xFF  # corrupt the signature
+        res = mp.check_tx(bytes(tx))
+        assert res.code == SignedKVStoreApplication.CODE_BAD_SIGNATURE
+        assert app.serial_verifies == 0  # verdict consumed, not recomputed
+        assert mp.size() == 0
+    finally:
+        sched.close()
+
+
+def test_plain_and_oversized_txs_skip_the_lane():
+    mp, app, sched = make_mp(max_tx_bytes=256)
+    try:
+        # non-envelope: no lane row, app sees NONE (and rejects the format)
+        res = mp.check_tx(b"plain=1")
+        assert res.code == SignedKVStoreApplication.CODE_BAD_ENVELOPE
+        # oversized: rejected before any signature work
+        with pytest.raises(TxTooLargeError):
+            mp.check_tx(stx.encode_signed_tx(PRIV, b"x" * 500))
+        assert sched.stats()["lanes"]["admission"]["rows_total"] == 0
+    finally:
+        sched.close()
+
+
+def test_duplicate_resident_tx_pays_no_second_verify():
+    mp, app, sched = make_mp()
+    try:
+        tx = stx.encode_signed_tx(PRIV, b"dup=1")
+        assert mp.check_tx(tx).code == abci.CODE_TYPE_OK
+        rows0 = sched.stats()["lanes"]["admission"]["rows_total"]
+        # duplicate via gossip: cache peek skips the device row entirely
+        assert mp.check_tx(tx, sender="peerA") is None
+        assert sched.stats()["lanes"]["admission"]["rows_total"] == rows0
+    finally:
+        sched.close()
+
+
+def test_check_tx_batch_single_lane_submit():
+    """The gossip-reactor path: N txs -> ONE admission-lane submit, each tx
+    still individually admitted/rejected."""
+    mp, app, sched = make_mp()
+    try:
+        txs = [stx.encode_signed_tx(PRIV, b"b=%d" % i) for i in range(8)]
+        bad = bytearray(txs[3])
+        bad[40] ^= 0xFF
+        txs[3] = bytes(bad)
+        out = mp.check_tx_batch(txs, sender="peerB")
+        codes = [r.code if r is not None else None for r in out]
+        assert codes[3] == SignedKVStoreApplication.CODE_BAD_SIGNATURE
+        assert all(c == abci.CODE_TYPE_OK for i, c in enumerate(codes) if i != 3)
+        assert mp.size() == 7
+        # one submit covered the whole batch
+        lane = sched.stats()["lanes"]["admission"]
+        assert lane["rows_total"] == 8
+        adm = [f for f in list(sched.flush_log) if "admission" in f["rows"]]
+        assert len(adm) == 1 and adm[0]["rows"]["admission"] == 8
+        assert app.serial_verifies == 0
+    finally:
+        sched.close()
+
+
+def test_precheck_degrades_to_app_verify_without_scheduler():
+    app = SignedKVStoreApplication()
+    mp = Mempool(LocalClient(app))  # no scheduler wired
+    assert not mp.sig_precheck
+    assert mp.check_tx(stx.encode_signed_tx(PRIV, b"k=v")).code == abci.CODE_TYPE_OK
+    assert app.serial_verifies == 1 and app.precheck_consumed == 0
+
+
+def test_precheck_survives_broken_scheduler():
+    """A scheduler that raises must degrade to NONE verdicts (the app
+    verifies), never lose txs."""
+
+    class Broken:
+        closed = False
+
+        def verify_rows(self, *a, **kw):
+            raise RuntimeError("device on fire")
+
+    app = SignedKVStoreApplication()
+    mp = Mempool(LocalClient(app), scheduler=Broken(), sig_precheck=True)
+    res = mp.check_tx(stx.encode_signed_tx(PRIV, b"k=v"))
+    assert res.code == abci.CODE_TYPE_OK
+    assert app.serial_verifies == 1  # degraded, not dropped
+
+
+def test_recheck_rides_the_admission_lane():
+    """Post-commit recheck re-verifies every resident envelope in ONE
+    admission-lane batch (residents are cache-resident, so the duplicate
+    peek is skipped) — the app consumes verdicts at recheck too."""
+    mp, app, sched = make_mp()
+    try:
+        txs = [stx.encode_signed_tx(PRIV, b"r=%d" % i) for i in range(5)]
+        for tx in txs:
+            assert mp.check_tx(tx).code == abci.CODE_TYPE_OK
+        serial0 = app.serial_verifies
+        rows0 = sched.stats()["lanes"]["admission"]["rows_total"]
+        with mp._lock:
+            mp.update(1, [txs[0]], [abci.ResponseDeliverTx(code=0)])
+        assert mp.size() == 4  # committed tx removed, rest rechecked
+        assert app.serial_verifies == serial0  # recheck consumed verdicts
+        assert sched.stats()["lanes"]["admission"]["rows_total"] == rows0 + 4
+    finally:
+        sched.close()
+
+
+# -- host-side RLC (the CPU-backend fast path behind the admission lane) -------
+
+
+def _rows(n, corrupt=()):
+    privs = [gen_ed25519(bytes([i % 250 + 1, i // 250]) + b"\x0b" * 30) for i in range(n)]
+    pk, ms, sg = [], [], []
+    for i, p in enumerate(privs):
+        m = b"hostrlc-%d" % i
+        s = bytearray(p.sign(m))
+        if i in corrupt:
+            s[2] ^= 0xFF
+        pk.append(p.pub_key().bytes())
+        ms.append(m)
+        sg.append(bytes(s))
+    return pk, ms, sg
+
+
+def test_host_rlc_byte_identical_to_serial():
+    from tendermint_tpu.crypto import batch as B
+
+    n = max(64, B._HOST_RLC_MIN)
+    pk, ms, sg = _rows(n, corrupt=(3, n - 1))
+    got = B.verify_batch_cpu(pk, ms, sg)
+    expect = [B.verify_batch_cpu([pk[i]], [ms[i]], [sg[i]])[0] for i in range(n)]
+    assert list(got) == expect
+    assert got.sum() == n - 2
+    # the all-pass batch takes the combined check, flagged in flush detail
+    pk2, ms2, sg2 = _rows(n)
+    B.LAST_FLUSH_DETAIL.clear()
+    assert B.verify_batch_cpu(pk2, ms2, sg2).all()
+    assert B.LAST_FLUSH_DETAIL.get("host_rlc") is True
+
+
+def test_host_rlc_rejects_invalid_encodings():
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.crypto.ed25519_ref import point_decompress
+
+    n = max(64, B._HOST_RLC_MIN)
+    pk, ms, sg = _rows(n)
+    # a 32-byte non-point pubkey and a non-point R must read False without
+    # poisoning their batchmates
+    bad_pk = bytes([2]) + b"\x00" * 30 + bytes([0])
+    assert point_decompress(bad_pk) is None or True  # shape only
+    pk[5] = bad_pk
+    sg[9] = b"\xff" * 32 + sg[9][32:]
+    got = B.verify_batch_cpu(pk, ms, sg)
+    expect = [B.verify_batch_cpu([pk[i]], [ms[i]], [sg[i]])[0] for i in range(n)]
+    assert list(got) == expect
+    assert not got[5] and not got[9]
+
+
+def test_host_rlc_gated_off_in_cofactorless_mode():
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.crypto import keys as K
+    from tendermint_tpu.crypto.keys import set_verify_mode
+
+    prev = "cofactorless" if K.cofactorless_mode() else "cofactored"
+    n = max(64, B._HOST_RLC_MIN)
+    pk, ms, sg = _rows(n)
+    try:
+        set_verify_mode("cofactorless")
+        B.LAST_FLUSH_DETAIL.clear()
+        assert B.verify_batch_cpu(pk, ms, sg).all()
+        # reference-exact mode: the serial loop, never the cofactored
+        # combined check
+        assert B.LAST_FLUSH_DETAIL.get("host_rlc") is None
+    finally:
+        set_verify_mode(prev)
+
+
+def test_wal_replay_readmits_signed_txs(tmp_path):
+    mp, app, sched = make_mp(wal_path=str(tmp_path / "wal"))
+    try:
+        txs = [stx.encode_signed_tx(PRIV, b"w=%d" % i) for i in range(3)]
+        for tx in txs:
+            assert mp.check_tx(tx).code == abci.CODE_TYPE_OK
+        mp.flush()  # drop pool + cache, keep the WAL
+        assert mp.replay_wal() == 3
+        assert mp.size() == 3
+    finally:
+        sched.close()
