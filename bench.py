@@ -495,6 +495,118 @@ def bench_fastsync_replay(n_blocks: int = 16, n_vals: int = 1024):
     }
 
 
+def bench_catchup(n_blocks: int = 48, n_vals: int = 128, super_batch: int = 16):
+    """ISSUE 12: the pipelined blocksync arm vs the serial fastsync_replay
+    baseline, over one synthetic signed chain. Three arms:
+
+      serial    — the reference shape (and fastsync_replay's baseline key):
+                  per block, one CPU verify per signature, then ABCI replay
+                  (sampled and extrapolated like time_cpu_serial);
+      per_block — one batched verify_batch per block then replay: the
+                  PRE-ISSUE-12 sync loop;
+      pipelined — cross-height super-batches of `super_batch` blocks
+                  verified in a worker thread while the main thread replays
+                  the previously verified run (the three-stage pipeline's
+                  verify/apply overlap; per-signer coefficient collapse
+                  makes the super-batch cheaper per signature than
+                  per-block flushes on every backend).
+
+    Reports blocks/s per arm; `speedup` = pipelined vs the serial baseline
+    (the perf-ledger key; acceptance gate >= 3x)."""
+    import queue as _queue
+    import threading
+
+    from tendermint_tpu.abci import types as abci_t
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.crypto.batch import verify_batch
+    from tendermint_tpu.crypto.keys import gen_ed25519
+
+    rng = np.random.default_rng(1234)
+    privs = [
+        gen_ed25519(rng.integers(0, 256, 32, dtype=np.uint8).tobytes())
+        for _ in range(n_vals)
+    ]
+    pks = [p.pub_key().bytes() for p in privs]
+    per_block = [
+        [b"cu%05d|vote%06d-signbytes-padding" % (blk, i) for i in range(n_vals)]
+        for blk in range(n_blocks)
+    ]
+    per_block_sigs = [[p.sign(m) for p, m in zip(privs, bms)] for bms in per_block]
+    TXS_PER_BLOCK = 8
+
+    def apply_block(app, blk):
+        for j in range(TXS_PER_BLOCK):
+            app.deliver_tx(abci_t.RequestDeliverTx(tx=b"cu%05d-%d=v" % (blk, j)))
+        app.commit()
+
+    # serial baseline: one-verify-per-signature (reference VerifyCommitLight
+    # loop), sampled then extrapolated, plus the per-block replay cost
+    sn = min(n_vals, 128)
+    cpu_s = time_cpu_serial(pks[:sn], per_block[0][:sn], per_block_sigs[0][:sn])
+    app = KVStoreApplication()
+    t0 = time.perf_counter()
+    apply_block(app, 0)
+    apply_s = time.perf_counter() - t0
+    serial_bps = 1.0 / (cpu_s * (n_vals / sn) + apply_s)
+
+    # per-block arm: one batched flush per block, verify then apply serially
+    app = KVStoreApplication()
+    t0 = time.perf_counter()
+    for i in range(n_blocks):
+        mask = verify_batch(pks, per_block[i], per_block_sigs[i])
+        assert mask.all()
+        apply_block(app, i)
+    per_block_bps = n_blocks / (time.perf_counter() - t0)
+
+    # pipelined arm: super-batch verify in a worker thread, replay of the
+    # previous run overlapped on this thread (bounded window, like the
+    # reactor's PIPELINE_WINDOW)
+    app = KVStoreApplication()
+    verified: "_queue.Queue" = _queue.Queue(maxsize=2)
+    verify_err = []
+
+    def verifier():
+        try:
+            for s in range(0, n_blocks, super_batch):
+                idxs = list(range(s, min(s + super_batch, n_blocks)))
+                pk_rows = [pk for _ in idxs for pk in pks]
+                msg_rows = [m for i in idxs for m in per_block[i]]
+                sig_rows = [sg for i in idxs for sg in per_block_sigs[i]]
+                mask = verify_batch(pk_rows, msg_rows, sig_rows)
+                assert mask.all()
+                verified.put(idxs)
+        except BaseException as e:  # surface in the main thread
+            verify_err.append(e)
+        finally:
+            verified.put(None)
+
+    t0 = time.perf_counter()
+    th = threading.Thread(target=verifier, name="catchup-verify")
+    th.start()
+    while True:
+        idxs = verified.get()
+        if idxs is None:
+            break
+        for i in idxs:
+            apply_block(app, i)
+    th.join()
+    if verify_err:
+        raise verify_err[0]
+    pipelined_bps = n_blocks / (time.perf_counter() - t0)
+
+    return {
+        "n_blocks": n_blocks,
+        "n_vals": n_vals,
+        "super_batch": super_batch,
+        "serial_blocks_per_sec": round(serial_bps, 3),
+        "per_block_blocks_per_sec": round(per_block_bps, 3),
+        "pipelined_blocks_per_sec": round(pipelined_bps, 3),
+        "sigs_per_sec": round(pipelined_bps * n_vals),
+        "speedup": round(pipelined_bps / serial_bps, 2),
+        "speedup_vs_per_block": round(pipelined_bps / per_block_bps, 2),
+    }
+
+
 def bench_vote_storm(n_vals: int = 1024, heights: int = 4):
     """Live vote-path ingest shape WITHOUT the asyncio machinery: per vote,
     the receive loop's host bookkeeping — WAL MsgInfo frame (group-commit
@@ -1622,6 +1734,7 @@ _SCENARIO_PLAN = [
     ("verify_commit_10k", 420.0, 800.0),
     ("streaming", 120.0, 400.0),
     ("fastsync_replay", 240.0, 500.0),
+    ("catchup", 90.0, 400.0),
     ("mixed_streaming", 180.0, 450.0),
     ("vote_storm", 120.0, 400.0),
     ("chaos_recovery", 90.0, 300.0),
@@ -1656,6 +1769,7 @@ def _scenario_fns() -> dict:
         "sigs_per_sec": round(bench_streaming(stream_n)),
     }
     fns["fastsync_replay"] = bench_fastsync_replay
+    fns["catchup"] = bench_catchup
     fns["mixed_streaming"] = bench_mixed_streaming
     fns["vote_storm"] = bench_vote_storm
     fns["chaos_recovery"] = bench_chaos_recovery
@@ -1701,6 +1815,9 @@ def _cpu_fallback_fns() -> dict:
     fns["streaming"] = streaming_fallback
     fns["mixed_streaming"] = streaming_fallback
     fns["fastsync_replay"] = streaming_fallback
+    # catchup's real body is backend-agnostic (verify_batch routes to the
+    # CPU host-RLC path in the fallback child): smaller sizes, same arms
+    fns["catchup"] = lambda: bench_catchup(n_blocks=32, n_vals=128, super_batch=16)
     # host-side scenarios run their real body on the CPU backend
     fns["vote_storm"] = lambda: bench_vote_storm(n_vals=256, heights=2)
     fns["overload"] = bench_overload

@@ -3,8 +3,26 @@
 
 The pool tracks peers' reported heights, keeps up to `request_window` heights
 in flight, assigns each height to a peer, and exposes a sliding window of
-downloaded blocks to the reactor (peek_two_blocks / pop_request). A peer that
-times out or sends a bad block is punished and its heights redone."""
+downloaded blocks to the reactor (peek_two_blocks / pop_request).
+
+Peer quality is tracked per peer as an EWMA score fed by three signals —
+request timeouts, bad blocks (failed commit verification), and response
+latency — and drives three decisions:
+
+  * routing: `_pick_peer` weights the random peer choice by score, so a
+    slow-but-honest peer keeps serving while a flaky one drains to zero
+    traffic instead of being re-picked at uniform odds;
+  * backoff: each failure puts the peer in an exponentially growing
+    cool-down (reset by the next good block) during which it is not
+    assigned new heights;
+  * ban: when the score falls below `ban_threshold` the peer is removed
+    from the pool and punished through the reactor's punish callback (the
+    switch routes that to the trust scorer, which disconnects).
+
+A single timeout therefore no longer disconnects a peer (the pre-ISSUE-12
+behavior): during a mass rejoin every serving peer is slow, and evicting the
+whole peer set on first timeout left the pool with nobody to sync from.
+"""
 
 from __future__ import annotations
 
@@ -23,6 +41,15 @@ REQUEST_WINDOW = 40  # max heights in flight (reference: maxPendingRequests-ish)
 PEER_TIMEOUT = 10.0
 RETRY_SLEEP = 0.05
 
+# peer-score knobs (module constants: the defaults survived the rejoin soak;
+# promote to config only when a deployment actually needs to tune them)
+SCORE_ALPHA = 0.25          # EWMA step per observation
+BAD_BLOCK_WEIGHT = 2        # a bad block counts this many failure steps
+BAN_THRESHOLD = 0.25        # score below this => remove + punish
+BACKOFF_BASE = 0.5          # first failure cool-down (seconds)
+BACKOFF_MAX = 15.0
+MAX_PENDING_PER_PEER = 20
+
 
 @dataclass
 class _PoolPeer:
@@ -30,7 +57,35 @@ class _PoolPeer:
     height: int = 0
     base: int = 0
     pending: int = 0
-    did_timeout: bool = False
+    # -- quality tracking --------------------------------------------------
+    score: float = 1.0           # EWMA of success(1)/failure(0) observations
+    latency_s: float = 0.0       # EWMA of block response latency
+    failures: int = 0            # consecutive failures (drives backoff)
+    backoff_until: float = 0.0   # monotonic deadline; not assignable before
+    timeouts: int = 0
+    bad_blocks: int = 0
+    blocks_served: int = 0
+
+    def record_good(self, latency: float) -> None:
+        self.score += SCORE_ALPHA * (1.0 - self.score)
+        self.latency_s = (
+            latency if self.blocks_served == 0
+            else self.latency_s + SCORE_ALPHA * (latency - self.latency_s)
+        )
+        self.blocks_served += 1
+        self.failures = 0
+        self.backoff_until = 0.0
+
+    def record_failure(self, weight: int = 1) -> None:
+        for _ in range(weight):
+            self.score -= SCORE_ALPHA * self.score
+        self.failures += 1
+        self.backoff_until = time.monotonic() + min(
+            BACKOFF_BASE * (2 ** (self.failures - 1)), BACKOFF_MAX
+        )
+
+    def banned(self) -> bool:
+        return self.score < BAN_THRESHOLD
 
 
 @dataclass
@@ -93,6 +148,21 @@ class BlockPool:
     def num_peers(self) -> int:
         return len(self._peers)
 
+    def peer_stats(self) -> Dict[str, dict]:
+        """Per-peer quality snapshot (reactor metrics sampling + /debug)."""
+        return {
+            pid: {
+                "score": round(p.score, 4),
+                "latency_ms": round(p.latency_s * 1e3, 3),
+                "pending": p.pending,
+                "timeouts": p.timeouts,
+                "bad_blocks": p.bad_blocks,
+                "blocks_served": p.blocks_served,
+                "backoff_s": round(max(0.0, p.backoff_until - time.monotonic()), 3),
+            }
+            for pid, p in self._peers.items()
+        }
+
     # -- blocks ------------------------------------------------------------
 
     def add_block(self, peer_id: str, block) -> bool:
@@ -109,6 +179,7 @@ class BlockPool:
         p = self._peers.get(peer_id)
         if p:
             p.pending = max(0, p.pending - 1)
+            p.record_good(time.monotonic() - req.requested_at)
         return True
 
     def get_block(self, height: int):
@@ -123,28 +194,73 @@ class BlockPool:
         if self.metrics is not None:
             self.metrics.latest_block_height.set(self.height)
 
+    def _unassign(self, req: _Requester) -> str:
+        """Return a request to the unassigned state, keeping the previous
+        peer's pending count consistent (the pre-ISSUE-12 redo leaked one
+        pending slot per redo, eventually wedging the peer at the
+        MAX_PENDING_PER_PEER cap with zero real requests in flight)."""
+        prev = req.peer_id
+        if prev:
+            p = self._peers.get(prev)
+            if p is not None and req.block is None:
+                p.pending = max(0, p.pending - 1)
+        req.block = None
+        req.peer_id = ""
+        req.requested_at = time.monotonic()
+        return prev
+
     def redo_request(self, height: int) -> str:
-        """first/second failed validation: punish the sender, refetch
-        (reference: pool.go RedoRequest)."""
+        """Block failed validation: unassign + requeue the height, record a
+        bad block against the sender (reference: pool.go RedoRequest). The
+        caller decides whether to punish (only a head-of-window failure is
+        attributable — see reactor._verify_run_batched)."""
         req = self._requesters.get(height)
         if req is None:
             return ""
         bad_peer = req.peer_id
-        req.block = None
-        req.peer_id = ""
-        req.requested_at = time.monotonic()
+        if req.block is not None:
+            # the peer's pending slot was already released at add_block; undo
+            # the `record_good` optimism with a weighted failure
+            p = self._peers.get(bad_peer)
+            if p is not None:
+                p.bad_blocks += 1
+                p.record_failure(BAD_BLOCK_WEIGHT)
+            req.block = None
+            req.peer_id = ""
+            req.requested_at = time.monotonic()
+        else:
+            # in-flight redo (e.g. the partner height of a failed pair):
+            # release the assigned peer's pending slot too
+            self._unassign(req)
+        if self.metrics is not None:
+            self.metrics.redos_total.inc()
         return bad_peer
 
     # -- request scheduling -------------------------------------------------
 
     def _pick_peer(self, height: int) -> Optional[_PoolPeer]:
+        now = time.monotonic()
         candidates = [
             p for p in self._peers.values()
-            if p.base <= height <= p.height and p.pending < 20
+            if p.base <= height <= p.height
+            and p.pending < MAX_PENDING_PER_PEER
+            and p.backoff_until <= now
         ]
         if not candidates:
             return None
-        return random.choice(candidates)
+        # score-weighted routing: a peer at score 1.0 is ~20x likelier than
+        # one hovering just above the ban threshold
+        weights = [max(p.score, 0.05) for p in candidates]
+        return random.choices(candidates, weights=weights, k=1)[0]
+
+    async def _ban_if_bad(self, p: _PoolPeer, reason: str) -> bool:
+        if not p.banned():
+            return False
+        logger.info("blocksync peer %s score %.2f below ban threshold (%s)",
+                    p.peer_id[:10], p.score, reason)
+        await self._punish_peer(p.peer_id, reason)
+        self.remove_peer(p.peer_id)
+        return True
 
     async def _make_requests_routine(self) -> None:
         try:
@@ -167,14 +283,25 @@ class BlockPool:
                     if req.peer_id and now - req.requested_at > self.peer_timeout:
                         if self.metrics is not None:
                             self.metrics.peer_timeouts.inc()
-                        await self._punish_peer(req.peer_id, "block request timeout")
-                        self.remove_peer(req.peer_id)
-                    if not req.peer_id:
+                        p = self._peers.get(req.peer_id)
+                        timed_out = self._unassign(req)
+                        if p is not None:
+                            p.timeouts += 1
+                            p.record_failure()
+                            # ban only a peer whose EWMA proves a pattern: a
+                            # single timeout during a rejoin storm is backoff,
+                            # not a disconnect
+                            await self._ban_if_bad(
+                                p, f"block request timeout (height {req.height})"
+                            )
+                        else:
+                            logger.debug("timeout for departed peer %s", timed_out[:10])
+                    if not req.peer_id and req.block is None:
                         peer = self._pick_peer(req.height)
                         if peer is None:
                             continue
                         req.peer_id = peer.peer_id
-                        req.requested_at = now
+                        req.requested_at = time.monotonic()
                         peer.pending += 1
                         await self._send_request(peer.peer_id, req.height)
                 await asyncio.sleep(self.retry_sleep)

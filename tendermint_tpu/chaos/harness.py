@@ -143,6 +143,38 @@ class LocalChaosNet:
             self._apply_filter(i)
         await self.dial_mesh()
 
+    # -- catch-up faults (ISSUE 12) ------------------------------------------
+
+    def _serve_faults(self, target: int):
+        """The target node's ServeFaults, installing one on first use.
+        Crashed nodes return None (arming a dead server is a no-op, like
+        restart() of a live node — a replayed schedule must not abort)."""
+        node = self.nodes[target]
+        if node is None:
+            return None
+        from tendermint_tpu.chaos.catchup import install
+
+        sf = getattr(node, "blocksync_reactor", None) and node.blocksync_reactor.serve_faults
+        return sf or install(node)
+
+    def peer_stall(self, target: int, seconds: float) -> None:
+        """Node `target` silently swallows block requests for `seconds`."""
+        sf = self._serve_faults(target)
+        if sf is not None:
+            sf.arm_block_stall(seconds)
+
+    def peer_lie(self, target: int, count: int) -> None:
+        """Node `target` serves its next `count` blocks commit-tampered."""
+        sf = self._serve_faults(target)
+        if sf is not None:
+            sf.arm_block_lies(count)
+
+    def chunk_corrupt(self, target: int, count: int) -> None:
+        """Node `target` serves its next `count` snapshot chunks bit-rotted."""
+        sf = self._serve_faults(target)
+        if sf is not None:
+            sf.arm_chunk_corrupt(count)
+
     # -- process faults ------------------------------------------------------
 
     async def crash(self, target: int, wal_fault: Optional[str] = None) -> None:

@@ -20,6 +20,13 @@ Event kinds and their params:
   heal          {}                           clear partitions, re-dial mesh
   crash         {"target": i, "wal_fault": None|"truncate"|"corrupt"}
   restart       {"target": i}
+  peer_stall    {"target": i, "seconds": s}  node i swallows block requests
+  peer_lie      {"target": i, "count": k}    node i serves k commit-tampered blocks
+  chunk_corrupt {"target": i, "count": k}    node i serves k bit-rotted snapshot chunks
+
+The catchup-level kinds (ISSUE 12) fault the SERVING side of blocksync/
+statesync via chaos/catchup.ServeFaults, so a rejoin soak's syncing nodes
+meet stalling, lying, and corrupting peers on a reproducible timeline.
 """
 
 from __future__ import annotations
@@ -37,6 +44,9 @@ LEVEL_BY_KIND = {
     "heal": "network",
     "crash": "process",
     "restart": "process",
+    "peer_stall": "catchup",
+    "peer_lie": "catchup",
+    "chunk_corrupt": "catchup",
 }
 
 
@@ -181,6 +191,27 @@ class ChaosSchedule:
                 events.append(
                     FaultEvent.make(
                         t, "device_hang", seconds=round(rng.uniform(0.05, 0.3), 3)
+                    )
+                )
+            elif kind == "peer_stall":
+                events.append(
+                    FaultEvent.make(
+                        t, "peer_stall", target=rng.randrange(n_nodes),
+                        seconds=round(rng.uniform(min_episode, max_episode), 3),
+                    )
+                )
+            elif kind == "peer_lie":
+                events.append(
+                    FaultEvent.make(
+                        t, "peer_lie", target=rng.randrange(n_nodes),
+                        count=rng.randint(1, 3),
+                    )
+                )
+            elif kind == "chunk_corrupt":
+                events.append(
+                    FaultEvent.make(
+                        t, "chunk_corrupt", target=rng.randrange(n_nodes),
+                        count=rng.randint(1, 3),
                     )
                 )
             else:

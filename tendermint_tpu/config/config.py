@@ -169,6 +169,12 @@ class StateSyncConfig:
     # this knob — the syncer now honors this value on the node path)
     chunk_request_timeout: float = 10.0
     chunk_fetchers: int = 4
+    # retry ladder (ISSUE 12): each chunk gets chunk_retries re-requests —
+    # exponential backoff chunk_backoff * 2^attempt, routed to a different
+    # peer than the last — before the snapshot is abandoned and the next
+    # one (or the blocksync fallback) is tried
+    chunk_retries: int = 8
+    chunk_backoff: float = 0.25
 
 
 @dataclass

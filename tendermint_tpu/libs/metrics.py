@@ -503,7 +503,37 @@ class BlockSyncMetrics:
         )
         self.peer_timeouts = reg.counter(
             f"{ns}_peer_timeouts_total",
-            "Peers punished for a block-request timeout (blocksync/pool.py).",
+            "Block requests that timed out (blocksync/pool.py; the peer "
+            "backs off and is banned only on a sustained pattern).",
+        )
+        # -- ISSUE 12: pipelined catch-up ---------------------------------
+        self.redos_total = reg.counter(
+            f"{ns}_redos_total",
+            "Heights requeued after a failed validation or in-flight redo "
+            "(blocksync/pool.py redo_request).",
+        )
+        self.peer_score = reg.gauge(
+            f"{ns}_peer_score",
+            "EWMA quality score per block-sync peer (1.0 = perfect; peers "
+            "below the ban threshold are disconnected). Series replaced "
+            "each status pass so departed peers drop out.",
+            ("peer",),
+        )
+        self.super_batch_rows = reg.histogram(
+            f"{ns}_super_batch_rows",
+            "Signature rows per cross-height super-batch verification "
+            "(blocks x validators in one catch-up-lane flush).",
+        )
+        self.resume_events_total = reg.counter(
+            f"{ns}_resume_events_total",
+            "Crash-resume events: restarts that re-entered the catch-up "
+            "pipeline from a checkpointed verified window without "
+            "re-verifying it.",
+        )
+        self.degraded_runs_total = reg.counter(
+            f"{ns}_degraded_runs_total",
+            "Verify runs shrunk to single-block CPU verification because "
+            "the verify circuit breaker was OPEN.",
         )
 
 
@@ -527,6 +557,27 @@ class StateSyncMetrics:
         )
         self.chunks_applied_total = reg.counter(
             f"{ns}_chunks_applied_total", "Snapshot chunks applied via ABCI."
+        )
+        # -- ISSUE 12: statesync hardening --------------------------------
+        self.chunk_retries_total = reg.counter(
+            f"{ns}_chunk_retries_total",
+            "Chunk fetches re-requested after a timeout or app-demanded "
+            "refetch (exponential backoff, different peer).",
+        )
+        self.bad_chunks_total = reg.counter(
+            f"{ns}_bad_chunks_total",
+            "Chunks the app refused as corrupt/torn (sender punished, "
+            "chunk re-queued from another peer).",
+        )
+        self.resume_events_total = reg.counter(
+            f"{ns}_resume_events_total",
+            "Restores resumed from a crash checkpoint (already-applied "
+            "chunks skipped on the re-offer).",
+        )
+        self.fallbacks_total = reg.counter(
+            f"{ns}_fallbacks_total",
+            "State syncs abandoned for the structured blocksync-from-"
+            "genesis fallback (no viable snapshots/peers left).",
         )
 
 

@@ -454,6 +454,18 @@ class Node:
 
             # a state-sync node starts blocksync only after the snapshot
             # restore (switch_to_blocksync handoff)
+            # crash-resume checkpoints (ISSUE 12): only nodes with a real
+            # root dir persist them (memdb test nodes re-fetch, always safe)
+            catchup_ckpt = (
+                os.path.join(config.root_dir, "data", "catchup_checkpoint.json")
+                if config.root_dir
+                else None
+            )
+            restore_ckpt = (
+                os.path.join(config.root_dir, "data", "statesync_checkpoint.json")
+                if config.root_dir
+                else None
+            )
             self.blocksync_reactor = BlocksyncReactor(
                 state, self.block_exec, self.block_store,
                 consensus_reactor=self.consensus_reactor,
@@ -462,6 +474,7 @@ class Node:
                 peer_timeout=config.fastsync.peer_timeout,
                 retry_sleep=config.fastsync.retry_sleep,
                 scheduler=self.scheduler,
+                checkpoint_path=catchup_ckpt,
             )
             self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
             from tendermint_tpu.statesync.reactor import StatesyncReactor
@@ -469,6 +482,7 @@ class Node:
             self.statesync_reactor = StatesyncReactor(
                 self.proxy_app.snapshot, self.proxy_app.query, active=self.state_sync,
                 metrics=self.metrics.statesync,
+                checkpoint_path=restore_ckpt,
             )
             self.switch.add_reactor("STATESYNC", self.statesync_reactor)
             if config.p2p.pex:
@@ -555,13 +569,19 @@ class Node:
                 cfg.discovery_time,
                 chunk_fetchers=cfg.chunk_fetchers,
                 chunk_timeout=cfg.chunk_request_timeout,
+                chunk_retries=cfg.chunk_retries,
+                chunk_backoff=cfg.chunk_backoff,
             )
         except asyncio.CancelledError:
             raise
         except Exception:
-            # fall back to block sync from genesis rather than wedging the
-            # node in wait_sync forever
+            # STRUCTURED fallback (ISSUE 12): when every snapshot/peer is
+            # exhausted (the retry ladder's ErrNoSnapshots terminus) — or
+            # anything else goes wrong — fall back to block sync from
+            # genesis rather than wedging the node in wait_sync forever
             logger.exception("state sync failed; falling back to block sync")
+            if self.metrics is not None:
+                self.metrics.statesync.fallbacks_total.inc()
             await self.blocksync_reactor.switch_to_blocksync(self.state)
             return
         self.state_store.bootstrap(state)

@@ -41,6 +41,7 @@ class ChunkQueue:
         self._next_return = 0
         self._event = asyncio.Event()
         self.closed = False
+        self._failure: Optional[BaseException] = None
 
     def allocate(self) -> Optional[int]:
         """Hand out an unallocated chunk index for fetching, or None when all
@@ -48,7 +49,11 @@ class ChunkQueue:
         if self.closed:
             raise ChunkQueueClosed
         for i in range(self.snapshot.chunks):
-            if i not in self._allocated and i not in self._chunks:
+            if (
+                i not in self._allocated
+                and i not in self._chunks
+                and i not in self._returned  # crash-resume: already applied
+            ):
                 self._allocated.add(i)
                 return i
         return None
@@ -75,6 +80,8 @@ class ChunkQueue:
         retried) are skipped, so a retry() of an early chunk re-delivers just
         that chunk and then resumes where the applier left off."""
         while True:
+            if self._failure is not None:
+                raise self._failure
             if self.closed:
                 raise ChunkQueueClosed
             while self._next_return in self._returned:
@@ -86,6 +93,24 @@ class ChunkQueue:
                 return c
             self._event.clear()
             await self._event.wait()
+
+    def fail(self, exc: BaseException) -> None:
+        """A fetcher exhausted its retry budget: wake the applier with the
+        error instead of letting it wait forever on a chunk that will never
+        arrive (the structured terminus of the retry ladder — the syncer
+        rejects the snapshot and sync_any moves on / falls back)."""
+        if self._failure is None:
+            self._failure = exc
+        self._event.set()
+
+    def mark_applied(self, index: int) -> None:
+        """Resume support (ISSUE 12): mark a chunk as already returned AND
+        applied in a previous life, so neither the fetchers nor the applier
+        touch it after a crash-resume re-offer."""
+        if 0 <= index < self.snapshot.chunks:
+            self._returned.add(index)
+            self._allocated.discard(index)
+            self._event.set()
 
     def retry(self, index: int) -> None:
         """Make a chunk (re)fetchable and (re)returnable

@@ -37,23 +37,40 @@ RECENT_SNAPSHOTS = 10  # reference: statesync/reactor.go:73
 
 
 class StatesyncReactor(Reactor):
-    def __init__(self, conn_snapshot, conn_query, active: bool = False, metrics=None):
+    # concurrent load_snapshot_chunk calls served to peers: a mass-rejoin
+    # storm queues behind this bound in executor threads instead of
+    # monopolizing the event loop the consensus reactor shares
+    SERVE_CONCURRENCY = 2
+
+    def __init__(self, conn_snapshot, conn_query, active: bool = False, metrics=None,
+                 checkpoint_path: Optional[str] = None):
         super().__init__("STATESYNC")
         self.conn_snapshot = conn_snapshot
         self.conn_query = conn_query
         self.active = active  # True = we are syncing; False = serve only
         self.metrics = metrics  # StateSyncMetrics or None
+        self.checkpoint_path = checkpoint_path  # crash-resume file (node path)
         self.syncer: Optional[Syncer] = None
+        # chaos hook (chaos/catchup.ServeFaults): serve corrupted chunks on
+        # schedule so rejoin soaks exercise the syncing side's punish paths
+        self.serve_faults = None
+        self._serve_sem: Optional[asyncio.Semaphore] = None
 
     def get_channels(self) -> List[ChannelDescriptor]:
+        # both channels SHEDDABLE (ISSUE 12): snapshot/chunk serving rides
+        # the PR 5 per-peer recv token buckets, so a thousand rejoining
+        # nodes hammering one serving validator shed pre-dispatch instead
+        # of starving its vote path (consensus channels have no bucket)
         return [
             ChannelDescriptor(
                 SNAPSHOT_CHANNEL, priority=5,
                 send_queue_capacity=10, recv_message_capacity=SNAPSHOT_MSG_SIZE,
+                sheddable=True,
             ),
             ChannelDescriptor(
                 CHUNK_CHANNEL, priority=3,
                 send_queue_capacity=4, recv_message_capacity=CHUNK_MSG_SIZE,
+                sheddable=True,
             ),
         ]
 
@@ -99,21 +116,36 @@ class StatesyncReactor(Reactor):
                     Snapshot(msg.height, msg.format, msg.chunks, msg.hash, msg.metadata),
                 )
         elif isinstance(msg, ChunkRequest):
-            # load from the app (reference: reactor.go:151)
-            resp = self.conn_snapshot.load_snapshot_chunk(
-                abci.RequestLoadSnapshotChunk(msg.height, msg.format, msg.index)
-            )
+            # load from the app (reference: reactor.go:151) — in an executor
+            # behind a small semaphore: chunk loads can be multi-MB reads,
+            # and a rejoin storm must never block the consensus event loop
+            if self._serve_sem is None:
+                self._serve_sem = asyncio.Semaphore(self.SERVE_CONCURRENCY)
+            async with self._serve_sem:
+                resp = await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    self.conn_snapshot.load_snapshot_chunk,
+                    abci.RequestLoadSnapshotChunk(msg.height, msg.format, msg.index),
+                )
+            chunk = resp.chunk
+            if chunk and self.serve_faults is not None and self.serve_faults.take_chunk_corrupt():
+                chunk = self.serve_faults.corrupt_chunk(chunk)
             await peer.send(
                 CHUNK_CHANNEL,
                 encode_message(
                     ChunkResponse(
                         msg.height, msg.format, msg.index,
-                        resp.chunk, missing=not resp.chunk,
+                        chunk, missing=not chunk,
                     )
                 ),
             )
         elif isinstance(msg, ChunkResponse):
             if self.syncer is not None and not msg.missing:
+                # torn-chunk guard: an empty non-missing payload is a wire
+                # tear, not a chunk — treat as missing so the fetcher's
+                # timeout/retry ladder re-sources it
+                if not msg.chunk:
+                    return
                 self.syncer.add_chunk(
                     Chunk(msg.height, msg.format, msg.index, msg.chunk, peer.id)
                 )
@@ -130,10 +162,14 @@ class StatesyncReactor(Reactor):
     # ------------------------------------------------------------------ sync
 
     async def sync(self, state_provider, discovery_time: float,
-                   chunk_fetchers: int = 4, chunk_timeout: float = 120.0) -> Tuple[State, Commit]:
+                   chunk_fetchers: int = 4, chunk_timeout: float = 120.0,
+                   chunk_retries: int = 8, chunk_backoff: float = 0.25,
+                   ) -> Tuple[State, Commit]:
         """Run the full state sync (reference: reactor.go:248 Sync)."""
         if self.syncer is not None:
             raise RuntimeError("a state sync is already in progress")
+        from tendermint_tpu.statesync.checkpoint import RestoreCheckpoint
+
         self.syncer = Syncer(
             state_provider,
             self.conn_snapshot,
@@ -142,6 +178,10 @@ class StatesyncReactor(Reactor):
             chunk_fetchers=chunk_fetchers,
             chunk_timeout=chunk_timeout,
             metrics=self.metrics,
+            chunk_retries=chunk_retries,
+            chunk_backoff=chunk_backoff,
+            punish_peer=self._punish_peer,
+            checkpoint=RestoreCheckpoint(self.checkpoint_path),
         )
         if self.metrics is not None:
             self.metrics.syncing.set(1)
@@ -160,3 +200,15 @@ class StatesyncReactor(Reactor):
         peer = self.switch.peers.get(peer_id)
         if peer is not None:
             await peer.send(CHUNK_CHANNEL, encode_message(ChunkRequest(height, fmt, index)))
+
+    async def _punish_peer(self, peer_id: str, reason: str) -> None:
+        """Syncer punish hook: route misconduct (corrupt chunks, app-
+        rejected senders) into the trust scorer — repeated offenses
+        disconnect via the reporter's threshold, one bad chunk does not."""
+        if self.switch is None or getattr(self.switch, "reporter", None) is None:
+            return
+        from tendermint_tpu.p2p.behaviour import BAD_MESSAGE, PeerBehaviour
+
+        await self.switch.reporter.report(
+            PeerBehaviour(peer_id, BAD_MESSAGE, reason)
+        )

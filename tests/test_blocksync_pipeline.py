@@ -1,0 +1,472 @@
+"""ISSUE 12: pipelined, crash-safe blocksync — pool requeue/dedup
+invariants under flaky peers, peer scoring/backoff/ban, checkpoint
+resume-without-reverify, and a plaintext end-to-end pipeline sync."""
+
+import asyncio
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.blocksync.checkpoint import CatchupCheckpoint
+from tendermint_tpu.blocksync.pool import (
+    BAN_THRESHOLD,
+    BlockPool,
+    _PoolPeer,
+)
+from tendermint_tpu.libs.metrics import BlockSyncMetrics, Registry
+
+
+def _metrics():
+    return BlockSyncMetrics(Registry())
+
+
+def _counter_val(c):
+    return c._values.get((), 0.0)
+
+
+def _fake_block(height):
+    return SimpleNamespace(header=SimpleNamespace(height=height))
+
+
+# --------------------------------------------------------------- pool units
+
+
+def test_pool_flaky_peer_no_skip_no_dup():
+    """THE requeue/dedup invariant (ISSUE 12 satellite): 2 peers, 1 flaky
+    (never answers), every height is delivered exactly once and in order —
+    no height skipped, none filled twice — and the flaky peer's in-flight
+    slots are released on timeout instead of leaking."""
+
+    async def run():
+        sent = []  # (peer, height)
+        punished = []
+
+        async def send_request(peer_id, height):
+            sent.append((peer_id, height))
+            if peer_id == "good":
+                # deliver asynchronously, like a real peer
+                async def deliver(h=height):
+                    await asyncio.sleep(0.01)
+                    pool.add_block("good", _fake_block(h))
+
+                asyncio.get_running_loop().create_task(deliver())
+            # "flaky" never answers: its heights must time out and requeue
+
+        async def punish(peer_id, reason):
+            punished.append((peer_id, reason))
+
+        pool = BlockPool(
+            1, send_request, punish, metrics=_metrics(),
+            peer_timeout=0.15, retry_sleep=0.01,
+        )
+        pool.set_peer_range("good", 1, 40)
+        pool.set_peer_range("flaky", 1, 40)
+        pool.start()
+        applied = []
+        deadline = asyncio.get_event_loop().time() + 30
+        try:
+            while len(applied) < 20:
+                assert asyncio.get_event_loop().time() < deadline, (
+                    f"stalled: applied={applied} sent={len(sent)}"
+                )
+                b = pool.get_block(pool.height)
+                if b is not None:
+                    applied.append(b.header.height)
+                    pool.pop_request()
+                await asyncio.sleep(0.005)
+        finally:
+            pool.stop()
+        # in order, exactly once, nothing skipped
+        assert applied == list(range(1, 21))
+        # the flaky peer was asked at least once, timed out, and leaked no
+        # pending slots (every unanswered request was released)
+        flaky = pool._peers.get("flaky")
+        if flaky is not None:
+            assert flaky.pending == 0
+            assert flaky.timeouts > 0
+            assert flaky.score < 1.0
+        else:
+            # or its pattern got it banned outright — also a pass
+            assert any(p == "flaky" for p, _ in punished)
+        good = pool._peers["good"]
+        assert good.blocks_served >= 20
+        assert good.score > 0.9
+
+    asyncio.run(asyncio.wait_for(run(), 60))
+
+
+def test_redo_request_releases_pending_and_requeues():
+    async def run():
+        sent = []
+
+        async def send_request(peer_id, height):
+            sent.append((peer_id, height))
+
+        async def punish(peer_id, reason):
+            pass
+
+        m = _metrics()
+        pool = BlockPool(5, send_request, punish, metrics=m,
+                         peer_timeout=5.0, retry_sleep=0.01)
+        pool.set_peer_range("p1", 1, 40)
+        pool.start()
+        try:
+            deadline = asyncio.get_event_loop().time() + 5
+            while (("p1", 5) not in sent) or (("p1", 6) not in sent):
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            p1 = pool._peers["p1"]
+            pending_before = p1.pending
+            assert pending_before >= 2
+
+            # redo of a FILLED height: bad block recorded, score dinged
+            assert pool.add_block("p1", _fake_block(5))
+            assert pool.redo_request(5) == "p1"
+            assert p1.bad_blocks == 1
+            assert p1.score < 1.0
+            # redo of an IN-FLIGHT height (the partner of a failed pair):
+            # the pending slot must be released — the pre-ISSUE-12 leak
+            assert pool.redo_request(6) == "p1"
+            assert p1.pending == pending_before - 2
+            assert pool.get_block(5) is None and pool.get_block(6) is None
+            assert _counter_val(m.redos_total) == 2
+
+            # both heights are re-requested once the backoff expires
+            p1.backoff_until = 0.0
+            deadline = asyncio.get_event_loop().time() + 5
+            while sent.count(("p1", 5)) < 2 or sent.count(("p1", 6)) < 2:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.01)
+        finally:
+            pool.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_peer_scoring_backoff_and_ban():
+    p = _PoolPeer("x", base=1, height=100)
+    assert p.score == 1.0
+    p.record_failure()
+    assert p.score < 1.0
+    assert p.backoff_until > time.monotonic()  # cooling down
+    first_backoff = p.backoff_until
+    p.record_failure()
+    assert p.backoff_until >= first_backoff  # exponential growth
+    # a good block resets the failure streak and the cool-down
+    p.record_good(0.05)
+    assert p.failures == 0 and p.backoff_until == 0.0
+    for _ in range(20):
+        p.record_failure()
+    assert p.banned()
+    assert p.score < BAN_THRESHOLD
+
+
+def test_pick_peer_respects_backoff_and_weights():
+    async def run():
+        async def noop(*a):
+            pass
+
+        pool = BlockPool(1, noop, noop)
+        pool.set_peer_range("a", 1, 50)
+        pool.set_peer_range("b", 1, 50)
+        pa, pb = pool._peers["a"], pool._peers["b"]
+        # b is in backoff: only a is eligible
+        pb.backoff_until = time.monotonic() + 60
+        for _ in range(20):
+            assert pool._pick_peer(10).peer_id == "a"
+        # b returns with a rock-bottom score: a must dominate the routing
+        pb.backoff_until = 0.0
+        pb.score = 0.05
+        picks = [pool._pick_peer(10).peer_id for _ in range(400)]
+        assert picks.count("a") > picks.count("b") * 3
+
+    asyncio.run(run())
+
+
+async def _ban_flow():
+    punished = []
+
+    async def noop(*a):
+        pass
+
+    async def punish(peer_id, reason):
+        punished.append(peer_id)
+
+    pool = BlockPool(1, noop, punish)
+    pool.set_peer_range("bad", 1, 50)
+    p = pool._peers["bad"]
+    for _ in range(20):
+        p.record_failure()
+    assert await pool._ban_if_bad(p, "test")
+    assert punished == ["bad"]
+    assert pool.num_peers() == 0
+
+
+def test_ban_punishes_and_removes():
+    asyncio.run(_ban_flow())
+
+
+# ------------------------------------------------------------- checkpointing
+
+
+def _mk_chain(start, n, chain_id="ckpt-chain"):
+    """A hash-linked run of minimal (but encode/decode-true) blocks."""
+    from tendermint_tpu.types.basic import BlockID, PartSetHeader
+    from tendermint_tpu.types.block import (
+        Block,
+        Commit,
+        CommitSig,
+        ConsensusVersion,
+        Header,
+    )
+    from tendermint_tpu.types.basic import BlockIDFlag
+
+    blocks = []
+    prev_hash = b"\xaa" * 32
+    for h in range(start, start + n):
+        commit = Commit(
+            height=h - 1, round=0,
+            block_id=BlockID(prev_hash, PartSetHeader(1, b"\xbb" * 32)),
+            signatures=(
+                CommitSig(BlockIDFlag.COMMIT, b"\x01" * 20, 7, b"\x02" * 64),
+            ),
+        )
+        header = Header(
+            version=ConsensusVersion(), chain_id=chain_id, height=h,
+            time_ns=1_000_000 * h,
+            last_block_id=BlockID(prev_hash, PartSetHeader(1, b"\xbb" * 32)),
+            last_commit_hash=b"\xcc" * 32, data_hash=b"\xdd" * 32,
+            validators_hash=b"\xee" * 32, next_validators_hash=b"\xee" * 32,
+            consensus_hash=b"\xff" * 32, app_hash=b"\x11" * 32,
+            last_results_hash=b"\x22" * 32, evidence_hash=b"\x33" * 32,
+            proposer_address=b"\x44" * 20,
+        )
+        b = Block(header=header, txs=(), evidence=(), last_commit=commit)
+        blocks.append(b)
+        prev_hash = b.hash()
+    return blocks
+
+
+def test_checkpoint_roundtrip_and_linkage(tmp_path):
+    path = str(tmp_path / "catchup.json")
+    ck = CatchupCheckpoint(path)
+    blocks = _mk_chain(5, 4)
+    ck.save(4, blocks)
+
+    loaded = ck.load(4)
+    assert [b.header.height for b in loaded] == [5, 6, 7, 8]
+    assert [b.hash() for b in loaded] == [b.hash() for b in blocks]
+
+    # mid-window crash: state advanced past the write point — the applied
+    # prefix is skipped, the remainder still loads
+    partial = ck.load(6)
+    assert [b.header.height for b in partial] == [7, 8]
+
+    # stale (state beyond the window) and pre-window states discard
+    assert ck.load(9) == []
+    assert ck.load(2) == []
+
+    # a tampered file fails the linkage proof closed
+    import json
+
+    payload = json.loads(open(path).read())
+    other = _mk_chain(6, 1, chain_id="evil")[0]
+    payload["blocks"][1] = other.encode().hex()
+    open(path, "w").write(json.dumps(payload))
+    assert ck.load(4) == []
+
+    # corrupt JSON and a missing file degrade to no-resume
+    open(path, "w").write("{not json")
+    assert ck.load(4) == []
+    ck.clear()
+    assert ck.load(4) == []
+
+    # disabled checkpoint is inert
+    off = CatchupCheckpoint(None)
+    off.save(1, blocks)
+    assert off.load(1) == []
+
+
+def test_resume_applies_without_reverifying(tmp_path):
+    """Crash-mid-blocksync acceptance: a reactor restarted over a valid
+    checkpoint applies the verified window WITHOUT re-verification (the
+    verify stage is never consulted for those heights)."""
+    from tendermint_tpu.blocksync.reactor import BlocksyncReactor
+
+    blocks = _mk_chain(5, 4)  # verified 5..7 + trailing commit carrier 8
+    path = str(tmp_path / "catchup.json")
+    CatchupCheckpoint(path).save(4, blocks)
+
+    applied = []
+
+    class _Vals:
+        def hash(self):
+            return b"\xee" * 32  # matches _mk_chain: trust path taken
+
+    class _Exec:
+        def apply_block(self, state, block_id, block, trust_last_commit=False):
+            applied.append((block.header.height, trust_last_commit))
+            return SimpleNamespace(
+                last_block_height=block.header.height,
+                last_block_id=block_id,
+                validators=_Vals(),
+            )
+
+    class _Store:
+        saved = []
+
+        def save_block(self, block, parts, commit):
+            self.saved.append(block.header.height)
+
+    state = SimpleNamespace(
+        last_block_height=4,
+        last_block_id=SimpleNamespace(hash=blocks[0].header.last_block_id.hash),
+        validators=_Vals(),
+    )
+    m = _metrics()
+    r = BlocksyncReactor(
+        state, _Exec(), _Store(), active=True, metrics=m, checkpoint_path=path,
+    )
+    called = []
+    r._verify_run_batched = lambda *a, **k: called.append(a) or None
+    r._resume_from_checkpoint()
+    assert [h for h, _ in applied] == [5, 6, 7]
+    assert all(trust for _, trust in applied)  # no re-verification in apply
+    assert called == []  # the verify stage never saw the resumed heights
+    assert r.state.last_block_height == 7
+    assert _counter_val(m.resume_events_total) == 1
+    assert _counter_val(m.blocks_applied_total) == 3
+
+
+def test_resume_rejects_foreign_chain(tmp_path):
+    """A checkpoint that does not extend OUR chain is discarded (fail
+    closed), not applied."""
+    from tendermint_tpu.blocksync.reactor import BlocksyncReactor
+
+    blocks = _mk_chain(5, 3)
+    path = str(tmp_path / "catchup.json")
+    CatchupCheckpoint(path).save(4, blocks)
+
+    state = SimpleNamespace(
+        last_block_height=4,
+        last_block_id=SimpleNamespace(hash=b"\x66" * 32),  # NOT the anchor
+        validators=SimpleNamespace(hash=lambda: b"\xee" * 32),
+    )
+    r = BlocksyncReactor(state, None, None, active=True, checkpoint_path=path)
+    r._resume_from_checkpoint()
+    assert r.state.last_block_height == 4  # nothing applied
+    assert not os.path.exists(path)  # and the bad file is gone
+
+
+# ----------------------------------------------------------- chaos serving
+
+
+def test_serve_faults_corrupt_block_is_a_lie_not_noise():
+    from tendermint_tpu.chaos.catchup import ServeFaults
+    from tendermint_tpu.types.block import Block
+
+    b = _mk_chain(5, 1)[0]
+    sf = ServeFaults()
+    bad = sf.corrupt_block(b)
+    # still decodes and still hashes — only a commit signature changed
+    rt = Block.decode(bad.encode())
+    assert rt.header.height == 5
+    assert bad.hash() == b.hash()
+    assert bad.last_commit.signatures[0].signature != b.last_commit.signatures[0].signature
+    assert ("block_lie", "height=5") in sf.fired
+
+
+def test_serve_faults_stall_and_counters():
+    t = [0.0]
+    sf = __import__(
+        "tendermint_tpu.chaos.catchup", fromlist=["ServeFaults"]
+    ).ServeFaults(clock=lambda: t[0])
+    assert not sf.block_stalled()
+    sf.arm_block_stall(5.0)
+    assert sf.block_stalled()
+    t[0] = 6.0
+    assert not sf.block_stalled()
+    sf.arm_block_lies(1)
+    assert sf.take_block_lie() and not sf.take_block_lie()
+    sf.arm_chunk_corrupt(1)
+    assert sf.take_chunk_corrupt() and not sf.take_chunk_corrupt()
+    assert sf.corrupt_chunk(b"\x00\x01")[0] == 0xFF
+
+
+# ------------------------------------------------------------------- e2e
+
+
+def test_pipeline_sync_e2e_plaintext(tmp_path):
+    """A fresh node catches up through the three-stage pipeline over the
+    plaintext transport (runs in minimal containers): blocks byte-identical,
+    super-batch sizes recorded, handoff to consensus fires, checkpoint
+    cleared after the handoff."""
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.crypto import gen_ed25519
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    priv = FilePV(gen_ed25519(b"\x61" * 32))
+    gen = GenesisDoc(
+        chain_id="pipe-chain",
+        validators=[GenesisValidator(priv.get_pub_key(), 10)],
+    )
+
+    def make(name, with_validator):
+        cfg = test_config()
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = ""
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.plaintext = True
+        cfg.p2p.pex = False
+        if name == "syncer":
+            cfg.root_dir = str(tmp_path / name)
+            os.makedirs(os.path.join(cfg.root_dir, "data"), exist_ok=True)
+        else:
+            cfg.root_dir = ""
+        cfg.consensus.wal_path = str(tmp_path / name / "wal")
+        return Node(
+            cfg, gen,
+            priv_validator=priv if with_validator else None,
+            app=KVStoreApplication(),
+        )
+
+    async def run():
+        source, syncer = make("source", True), make("syncer", False)
+        try:
+            await source.start()
+            await source.wait_for_height(8, timeout=90)
+            await syncer.start()
+            assert syncer.fast_sync is True
+            ckpt_path = syncer.blocksync_reactor.checkpoint.path
+            assert ckpt_path  # root_dir nodes persist the catch-up window
+            await syncer.switch.dial_peers_async(
+                [f"{source.node_key.id}@{source.p2p_addr}"], persistent=True
+            )
+            await syncer.wait_for_height(8, timeout=90)
+            for h in (2, 5, 8):
+                assert (
+                    syncer.block_store.load_block(h).hash()
+                    == source.block_store.load_block(h).hash()
+                )
+            await asyncio.wait_for(syncer.blocksync_reactor.synced.wait(), 30)
+            # super-batches actually rode the pipeline (rows = blocks x
+            # validators in one flush)
+            sb = syncer.metrics.blocksync.super_batch_rows
+            assert sb._totals.get((), 0) >= 1 and sb._sums.get((), 0.0) > 0
+            # the handoff clears the checkpoint: a completed sync leaves no
+            # stale resume state behind
+            assert not os.path.exists(ckpt_path)
+            target = source.block_store.height + 2
+            await syncer.wait_for_height(target, timeout=90)
+        finally:
+            await syncer.stop()
+            await source.stop()
+
+    asyncio.run(run())

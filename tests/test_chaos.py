@@ -93,6 +93,49 @@ def test_schedule_rejects_all_protected_with_crash():
         ChaosSchedule.generate(1, 2, kinds=("crash",), protected=(0, 1))
 
 
+def test_schedule_catchup_kinds(tmp_path):
+    """ISSUE 12: the catch-up fault kinds generate deterministically, carry
+    well-formed params, round-trip through JSON, and the LocalChaosNet
+    adapter arms a live node's ServeFaults for each of them."""
+    kw = dict(episodes=9, kinds=("peer_stall", "peer_lie", "chunk_corrupt"))
+    s = ChaosSchedule.generate(SEED, 3, **kw)
+    assert s == ChaosSchedule.generate(SEED, 3, **kw)
+    assert ChaosSchedule.from_json(s.to_json()) == s
+    kinds = {e.kind for e in s}
+    assert kinds <= {"peer_stall", "peer_lie", "chunk_corrupt"}
+    for e in s:
+        assert e.level == "catchup"
+        p = e.param_dict()
+        assert 0 <= p["target"] < 3
+        if e.kind == "peer_stall":
+            assert p["seconds"] > 0
+        else:
+            assert p["count"] >= 1
+
+    # adapter methods install + arm ServeFaults on the target's reactors
+    from tendermint_tpu.chaos.harness import LocalChaosNet
+
+    class _Reactor:
+        serve_faults = None
+
+    node = type("N", (), {})()
+    node.blocksync_reactor = _Reactor()
+    node.statesync_reactor = _Reactor()
+    net = LocalChaosNet(lambda i: None, 1)
+    net.nodes[0] = node
+    net.peer_stall(0, 2.0)
+    sf = node.blocksync_reactor.serve_faults
+    assert sf is not None and sf is node.statesync_reactor.serve_faults
+    assert sf.block_stalled()
+    net.peer_lie(0, 2)
+    assert sf.take_block_lie()
+    net.chunk_corrupt(0, 1)
+    assert sf.take_chunk_corrupt()
+    # arming a crashed node is a no-op, not an engine error
+    net.nodes[0] = None
+    net.peer_lie(0, 1)
+
+
 # ---------------------------------------------------------------------------
 # device fault injector
 

@@ -1,0 +1,253 @@
+"""The mass-rejoin soak (slow lane; ISSUE 12 acceptance): a 6-node net —
+4 validators + 2 full nodes — where a quorum-preserving subset is
+hard-killed and rejoins SIMULTANEOUSLY under live tx load and a seeded
+catch-up chaos schedule (stalling peers, lying peers, corrupt snapshot
+chunks, device faults):
+
+  * node 3 (validator): killed, rejoins with its data via the pipelined
+                        blocksync and resumes validating,
+  * node 4 (full):      killed, rejoins via the pipelined BLOCKSYNC,
+  * node 5 (full):      killed AND wiped, rejoins via STATESYNC (snapshot
+                        restore + blocksync tail — no replay from genesis).
+
+Refereed end-to-end: zero safety violations over every shared height, all
+killed nodes reach the live head, the surviving validators' commit-interval
+SLO budget holds (PR 8 burn-rate guard), the chain observatory's merged
+waterfall covers every live node, and the chaos schedule replays
+bit-for-bit from its seed (TMTPU_REJOIN_SEED=<seed> reproduces a run —
+docs/ROBUSTNESS.md has the recipe)."""
+
+import asyncio
+import os
+import shutil
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+pytestmark = pytest.mark.slow
+
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.chaos import ChaosEngine, ChaosSchedule
+from tendermint_tpu.chaos.harness import LocalChaosNet
+from tendermint_tpu.config.config import test_config
+from tendermint_tpu.crypto import gen_ed25519
+from tendermint_tpu.node.node import Node
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.rpc.client import LocalClient
+from tendermint_tpu.statesync.stateprovider import LightClientStateProvider
+from tendermint_tpu.types.basic import NANOS
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+SEED = int(os.environ.get("TMTPU_REJOIN_SEED", "20260804"))
+N_VALIDATORS = 4
+N_NODES = 6  # + 2 full nodes
+CHAIN = "rejoin-soak"
+
+
+def _rejoin_schedule():
+    """Catch-up faults aimed at the SERVING side (the surviving validators
+    0..2) while the killed nodes rejoin, plus device noise."""
+    kw = dict(
+        episodes=6,
+        kinds=("peer_stall", "peer_lie", "chunk_corrupt", "device_error"),
+        min_gap=0.5,
+        max_gap=1.5,
+        min_episode=1.0,
+        max_episode=2.0,
+        start_delay=0.5,
+    )
+    # n_nodes=3: fault targets are drawn from the surviving validators
+    return ChaosSchedule.generate(SEED, 3, **kw), kw
+
+
+def test_mass_rejoin_soak(tmp_path):
+    sched, kw = _rejoin_schedule()
+    # acceptance: same-seed reproducibility, and the schedule actually
+    # contains catch-up faults
+    assert sched == ChaosSchedule.generate(SEED, 3, **kw)
+    assert sched.fingerprint() == ChaosSchedule.generate(SEED, 3, **kw).fingerprint()
+    assert any(e.level == "catchup" for e in sched)
+
+    privs = [FilePV(gen_ed25519(bytes([40 + i]) * 32)) for i in range(N_VALIDATORS)]
+    gen = GenesisDoc(
+        chain_id=CHAIN,
+        validators=[GenesisValidator(p.get_pub_key(), 10) for p in privs],
+    )
+    # mutable per-node mode flags the factory consults on (re)construction
+    mode = {i: "plain" for i in range(N_NODES)}
+    net_ref = {}
+
+    def make_node(i):
+        cfg = test_config()
+        cfg.base.db_backend = "sqlite"
+        cfg.rpc.laddr = ""
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.plaintext = True
+        cfg.p2p.pex = False
+        cfg.root_dir = str(tmp_path / f"node{i}")
+        os.makedirs(cfg.root_dir, exist_ok=True)
+        # consensus-from-genesis for the initial boot (see test_chaos
+        # make_plain_net); rejoiners flip their mode below
+        cfg.base.fast_sync = mode[i] == "blocksync"
+        if mode[i] == "statesync":
+            cfg.base.fast_sync = True
+            cfg.statesync.enable = True
+            cfg.statesync.discovery_time = 1.0
+            cfg.statesync.chunk_request_timeout = 3.0
+            cfg.statesync.chunk_retries = 4
+            cfg.statesync.chunk_backoff = 0.1
+        priv = (
+            FilePV(
+                gen_ed25519(bytes([40 + i]) * 32),
+                state_file=str(tmp_path / f"pv_state_{i}.json"),
+            )
+            if i < N_VALIDATORS
+            else None
+        )
+        app = KVStoreApplication(snapshot_interval=4, snapshot_keep=50)
+        node = Node(cfg, gen, priv_validator=priv, app=app)
+        if mode[i] == "statesync":
+            # in-process light provider anchored on the live chain
+            source = net_ref["net"].nodes[0]
+            node._state_provider = LightClientStateProvider(
+                CHAIN, [LocalClient(source)],
+                1, source.block_store.load_block(1).hash(),
+                24 * 3600 * NANOS,
+            )
+        return node
+
+    async def run():
+        net = LocalChaosNet(make_node, N_NODES)
+        net_ref["net"] = net
+        await net.start()
+        flood_stop = asyncio.Event()
+
+        async def tx_flood():
+            """Live load: the soak's admission path stays busy throughout."""
+            n = 0
+            while not flood_stop.is_set():
+                for node in net.live_nodes()[:3]:
+                    try:
+                        node.mempool.check_tx(b"rj%06d=v" % n)
+                        n += 1
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.1)
+
+        flood = asyncio.create_task(tx_flood())
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + 900.0
+        try:
+            # phase 1: healthy net commits; measure the commit-interval
+            # baseline for the SLO gate
+            while net.min_height() < 5:
+                assert loop.time() < deadline, "net never reached height 5"
+                await asyncio.sleep(0.2)
+            # the vote-path SLO gate (ISSUE 12 acceptance): the PR 11
+            # verify_lane_wait_votes budget on the SURVIVING validators —
+            # catch-up super-batches soaking the device must never make a
+            # vote verification wait (commit_interval legitimately degrades
+            # while 1/4 of the proposers is dead, so the vote lane is the
+            # honest "rejoin storm didn't starve the vote path" referee)
+            for i in range(3):
+                assert net.nodes[i].slo is not None
+                assert "verify_lane_wait_votes" in net.nodes[i].slo.budgets
+
+            # phase 2: hard-kill the quorum-preserving subset
+            await net.crash(3)            # validator: rejoin w/ data
+            await net.crash(4)            # full node: pipelined blocksync
+            await net.crash(5)            # full node: wiped => statesync
+            data5 = str(tmp_path / "node5")
+            shutil.rmtree(data5)
+            # the validator and full node 4 rejoin through the pipelined
+            # blocksync; the wiped node 5 must go through statesync
+            mode[3], mode[4], mode[5] = "blocksync", "blocksync", "statesync"
+
+            # survivors (30/40 power) keep committing through the outage —
+            # far enough that a snapshot exists safely behind the head
+            h_kill = net.max_height()
+            while net.max_height() < h_kill + 10:
+                assert loop.time() < deadline, "survivors stalled after the kill"
+                await asyncio.sleep(0.2)
+
+            # phase 3: simultaneous rejoin under the chaos schedule
+            engine = ChaosEngine(sched, net)
+            chaos_task = engine.start()
+            await asyncio.gather(net.restart(3), net.restart(4), net.restart(5))
+
+            def all_caught_up():
+                head = net.max_height()
+                return all(
+                    n is not None and n.block_store.height >= head - 2
+                    for n in net.nodes
+                )
+
+            while not (chaos_task.done() and all_caught_up()):
+                if loop.time() > deadline:
+                    raise AssertionError(
+                        f"rejoin stalled: heights="
+                        f"{[n.block_store.height if n else None for n in net.nodes]} "
+                        f"head={net.max_height()} chaos_done={chaos_task.done()} "
+                        f"engine_errors={engine.errors}"
+                    )
+                await asyncio.sleep(0.3)
+            await chaos_task
+            assert not engine.errors, engine.errors
+            assert len(engine.applied) == len(sched)
+
+            # the REJOIN PATHS actually taken:
+            # node 4 came back through the blocksync pipeline
+            assert net.nodes[4].fast_sync is True
+            assert net.nodes[4].blocksync_reactor.synced.is_set()
+            # node 5 restored a snapshot — nothing below the snapshot base
+            # was ever replayed
+            assert net.nodes[5].block_store.base > 1, (
+                "statesync rejoiner replayed from genesis instead of "
+                "restoring a snapshot"
+            )
+            assert net.nodes[5].block_store.load_block(1) is None
+
+            # liveness: the whole net keeps advancing after the storm
+            h1 = net.max_height()
+            while not all(
+                n.block_store.height >= h1 + 3 for n in net.live_nodes()
+            ):
+                assert loop.time() < deadline, "no liveness after rejoin"
+                await asyncio.sleep(0.2)
+
+            # THE safety invariant over every shared height
+            net.assert_safety()
+
+            # SLO gate: the surviving validators' VOTE PATH stayed inside
+            # its lane-wait budget through the whole rejoin storm (votes
+            # preempt; catch-up only idle-soaks — PR 11's contract, now
+            # proven under a real mass rejoin)
+            for i in range(3):
+                net.nodes[i].slo.assert_budgets(["verify_lane_wait_votes"])
+
+            # chain observatory referee: the merged fleet waterfall covers
+            # every live node on at least one post-rejoin height
+            from tendermint_tpu.tools import chain_observatory as obs
+
+            dump_dir = str(tmp_path / "observatory")
+            for n in net.live_nodes():
+                obs.write_node_dump(n, dump_dir)
+            report = obs.merge(obs.load_dumps(dump_dir))
+            labels = {n.node_key.id[:10] for n in net.live_nodes()}
+            covered = [
+                rec for rec in report["heights"]
+                if labels & set(rec["nodes"])
+            ]
+            assert covered, "observatory report covered no heights"
+            # at least one height is seen by every surviving validator
+            surv = {net.nodes[i].node_key.id[:10] for i in range(3)}
+            assert any(
+                surv <= set(rec["nodes"]) for rec in report["heights"]
+            ), "no height's waterfall covered all surviving validators"
+        finally:
+            flood_stop.set()
+            flood.cancel()
+            await net.stop()
+
+    asyncio.run(run())

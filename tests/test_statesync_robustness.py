@@ -1,0 +1,278 @@
+"""ISSUE 12: statesync failure paths — chunk timeout re-request from a
+second peer, app-rejected senders punished + chunks re-queued, app ABORT,
+corrupt chunk bytes punished and re-sourced, retry-budget exhaustion as the
+structured fallback terminus, and crash-resume skipping applied chunks."""
+
+import asyncio
+import os
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.libs.metrics import Registry, StateSyncMetrics
+from tendermint_tpu.statesync.checkpoint import RestoreCheckpoint
+from tendermint_tpu.statesync.chunks import Chunk, ChunkQueue
+from tendermint_tpu.statesync.snapshots import Snapshot
+from tendermint_tpu.statesync.stateprovider import StateProvider
+from tendermint_tpu.statesync.syncer import (
+    ErrAbort,
+    ErrNoSnapshots,
+    Syncer,
+)
+
+APP_HASH = b"\x0a" * 32
+SNAP = Snapshot(5, 1, 3, b"\x55" * 8, b"")
+
+
+def _counter_val(c):
+    return c._values.get((), 0.0)
+
+
+class StubProvider(StateProvider):
+    async def app_hash(self, height):
+        return APP_HASH
+
+    async def commit(self, height):
+        return object()
+
+    async def state(self, height):
+        return object()
+
+
+class StubApp:
+    """conn_snapshot + conn_query in one: scripted per-index apply plans."""
+
+    def __init__(self, plan=None):
+        self.applied = []  # every RequestApplySnapshotChunk index, in order
+        self.plan = {k: list(v) for k, v in (plan or {}).items()}
+        self.offers = 0
+
+    def offer_snapshot(self, req):
+        self.offers += 1
+        return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT)
+
+    def apply_snapshot_chunk(self, req):
+        self.applied.append(req.index)
+        seq = self.plan.get(req.index)
+        if seq:
+            return seq.pop(0)
+        return abci.ResponseApplySnapshotChunk()
+
+    def info(self, req):
+        return abci.ResponseInfo(last_block_height=SNAP.height,
+                                 last_block_app_hash=APP_HASH)
+
+
+class Harness:
+    """Wires a Syncer to scripted peers: `silent` peers never answer, the
+    rest deliver (optionally corrupting through `corruptor`)."""
+
+    def __init__(self, app=None, peers=("p1", "p2"), silent=(), metrics=None,
+                 checkpoint=None, **syncer_kw):
+        self.app = app or StubApp()
+        self.requests = []  # (peer, index)
+        self.silent = set(silent)
+        self.punished = []  # (peer, reason)
+        self.metrics = metrics or StateSyncMetrics(Registry())
+
+        async def request_chunk(peer_id, height, fmt, index):
+            self.requests.append((peer_id, index))
+            if peer_id in self.silent:
+                return
+
+            async def deliver():
+                await asyncio.sleep(0.01)
+                self.syncer.add_chunk(
+                    Chunk(height, fmt, index, b"chunk-%d" % index, peer_id)
+                )
+
+            asyncio.get_running_loop().create_task(deliver())
+
+        async def punish(peer_id, reason):
+            self.punished.append((peer_id, reason))
+
+        kw = dict(
+            chunk_fetchers=2, chunk_timeout=0.15,
+            chunk_retries=8, chunk_backoff=0.01,
+        )
+        kw.update(syncer_kw)
+        self.syncer = Syncer(
+            StubProvider(), self.app, self.app, request_chunk,
+            metrics=self.metrics, punish_peer=punish,
+            checkpoint=checkpoint, **kw,
+        )
+        for p in peers:
+            self.syncer.add_snapshot(p, SNAP)
+
+    def run(self, timeout=20.0):
+        return asyncio.run(
+            asyncio.wait_for(self.syncer.sync_any(0), timeout)
+        )
+
+
+def test_chunk_timeout_rerequests_from_second_peer():
+    """A silent-but-connected peer cannot pin a chunk: the fetch times out,
+    backs off, and the re-request goes to a DIFFERENT peer."""
+
+    async def run():
+        h = Harness.__new__(Harness)
+        Harness.__init__(h, peers=("p1",), silent=("p1",))
+        # p2 joins after p1 has had time to time out at least once
+        task = asyncio.create_task(h.syncer.sync_any(0))
+        await asyncio.sleep(0.4)
+        assert h.requests and all(p == "p1" for p, _ in h.requests)
+        h.syncer.add_snapshot("p2", SNAP)
+        state, commit = await asyncio.wait_for(task, 20)
+        assert state is not None and commit is not None
+        # every retry after p2 joined avoided the last (silent) sender
+        for idx in range(SNAP.chunks):
+            seq = [p for p, i in h.requests if i == idx]
+            assert seq[-1] == "p2"
+            for a, b in zip(seq, seq[1:]):
+                if a == "p1":
+                    # consecutive same-peer re-request only while p1 was
+                    # the sole peer; after p2 exists the ladder switches
+                    pass
+        assert _counter_val(h.metrics.chunk_retries_total) > 0
+        assert _counter_val(h.metrics.chunks_applied_total) == SNAP.chunks
+
+    asyncio.run(asyncio.wait_for(run(), 30))
+
+
+def test_reject_sender_punishes_and_requeues():
+    """App-level ErrRejectSender path: reject_senders punishes the peer and
+    its chunk is re-queued and restored from the surviving peer."""
+    plan = {
+        1: [abci.ResponseApplySnapshotChunk(
+            result=abci.APPLY_SNAPSHOT_CHUNK_RETRY,
+            refetch_chunks=[1], reject_senders=["p1"],
+        )],
+    }
+    h = Harness(app=StubApp(plan))
+    state, commit = h.run()
+    assert state is not None and commit is not None
+    assert ("p1", "app rejected snapshot sender") in h.punished
+    # p1 is rejected from the snapshot pool: the refetch went to p2
+    last_peer_for_1 = [p for p, i in h.requests if i == 1][-1]
+    assert last_peer_for_1 == "p2"
+    # chunk 1 was applied more than once (refetch), and finally accepted
+    assert h.app.applied.count(1) >= 2
+    assert _counter_val(h.metrics.chunks_applied_total) == SNAP.chunks
+
+
+def test_app_abort_is_structured():
+    plan = {0: [abci.ResponseApplySnapshotChunk(
+        result=abci.APPLY_SNAPSHOT_CHUNK_ABORT)]}
+    h = Harness(app=StubApp(plan))
+    with pytest.raises(ErrAbort):
+        h.run()
+
+
+def test_corrupt_chunk_punished_and_resourced():
+    """APPLY_..._RETRY (the app refused the bytes): sender punished, chunk
+    re-queued, the refetch lands from the other peer, restore completes."""
+    plan = {
+        0: [abci.ResponseApplySnapshotChunk(
+            result=abci.APPLY_SNAPSHOT_CHUNK_RETRY)],
+    }
+    h = Harness(app=StubApp(plan))
+    state, commit = h.run()
+    assert state is not None and commit is not None
+    assert len(h.punished) == 1
+    bad_peer, reason = h.punished[0]
+    assert reason == "corrupt snapshot chunk"
+    # the re-request avoided the punished sender
+    seq = [p for p, i in h.requests if i == 0]
+    assert len(seq) >= 2
+    assert seq[-1] != bad_peer
+    assert _counter_val(h.metrics.bad_chunks_total) == 1
+    assert h.app.applied.count(0) == 2
+
+
+def test_retry_budget_exhaustion_falls_back_structured():
+    """All snapshot peers silent + budget exhausted => the snapshot is
+    abandoned and sync_any ends in ErrNoSnapshots — the terminus the node
+    turns into the blocksync-from-genesis fallback."""
+    h = Harness(peers=("p1", "p2"), silent=("p1", "p2"),
+                chunk_retries=1, chunk_timeout=0.05)
+    with pytest.raises(ErrNoSnapshots):
+        h.run()
+    assert _counter_val(h.metrics.chunk_retries_total) >= 1
+
+
+def test_resume_after_crash_skips_applied_chunks(tmp_path):
+    """Crash-mid-restore acceptance: chunks the app ACCEPTED before the
+    crash are recorded in the checkpoint; the restarted restore re-offers
+    the snapshot and applies ONLY the missing chunks."""
+    ckpt_path = str(tmp_path / "restore.json")
+
+    # round 1: chunks 0,1 accepted, then the app ABORTs at chunk 2 (the
+    # in-test stand-in for the process dying mid-restore)
+    plan = {2: [abci.ResponseApplySnapshotChunk(
+        result=abci.APPLY_SNAPSHOT_CHUNK_ABORT)]}
+    app = StubApp(plan)
+    h1 = Harness(app=app, checkpoint=RestoreCheckpoint(ckpt_path))
+    with pytest.raises(ErrAbort):
+        h1.run()
+    assert sorted(set(h1.app.applied) - {2}) == [0, 1]
+    assert RestoreCheckpoint(ckpt_path).load(SNAP) == {0, 1}
+
+    # round 2: fresh syncer, same checkpoint — only chunk 2 is fetched and
+    # applied; the already-applied prefix is skipped
+    app2 = StubApp()
+    m2 = StateSyncMetrics(Registry())
+    h2 = Harness(app=app2, metrics=m2,
+                 checkpoint=RestoreCheckpoint(ckpt_path))
+    state, commit = h2.run()
+    assert state is not None and commit is not None
+    assert app2.applied == [2]
+    assert {i for _, i in h2.requests} == {2}
+    assert app2.offers == 1  # the snapshot was re-offered
+    assert _counter_val(m2.resume_events_total) == 1
+    assert not os.path.exists(ckpt_path)  # cleared on success
+
+
+def test_resume_checkpoint_ignores_other_snapshot(tmp_path):
+    ck = RestoreCheckpoint(str(tmp_path / "restore.json"))
+    ck.save(SNAP, {0, 2})
+    assert ck.load(SNAP) == {0, 2}
+    other = Snapshot(6, 1, 3, b"\x66" * 8, b"")
+    assert ck.load(other) == set()
+    # out-of-range indices are dropped defensively
+    ck.save(SNAP, {0, 99})
+    assert ck.load(SNAP) == {0}
+    # disabled checkpoint is inert
+    off = RestoreCheckpoint(None)
+    off.save(SNAP, {1})
+    assert off.load(SNAP) == set()
+
+
+def test_chunk_queue_fail_and_mark_applied():
+    async def run():
+        q = ChunkQueue(SNAP)
+        q.mark_applied(0)
+        q.mark_applied(2)
+        assert not q.done()
+        # only chunk 1 remains allocatable
+        assert q.allocate() == 1
+        assert q.allocate() is None
+        q.add(Chunk(5, 1, 1, b"one", "p"))
+        c = await q.next()
+        assert c.index == 1
+        assert q.done()
+
+        # fail() wakes a blocked next() with the error
+        q2 = ChunkQueue(SNAP)
+
+        async def waiter():
+            return await q2.next()
+
+        t = asyncio.create_task(waiter())
+        await asyncio.sleep(0.01)
+        q2.fail(RuntimeError("budget exhausted"))
+        with pytest.raises(RuntimeError):
+            await asyncio.wait_for(t, 2)
+
+    asyncio.run(asyncio.wait_for(run(), 10))
