@@ -24,6 +24,18 @@ Sub-benchmarks (in "extra", budget permitting):
   verify_commit_1k    — VerifyCommit, 1k validators (config 2)
   light_trusting_4k   — VerifyCommitLightTrusting, 4k validators (config 3)
   verify_commit_10k   — the north-star config
+  verify_commit_100k  — ONE 100k-validator commit through the streamed
+                        flush planner (crypto/batch.py, ISSUE 13):
+                        fixed-bucket chunks, double-buffered host prep,
+                        on-device partial accumulation; reports chunk
+                        telemetry (chunks/chunk_lanes/prep_overlap_ms/
+                        peak_lanes_in_flight + the 2-chunk double-buffer
+                        bound as lanes_in_flight_ok), slope_samples, and
+                        speedup vs the extrapolated serial baseline
+  super_batch         — multi-commit cross-height super-batch: H commits x
+                        V validators as ONE streamed flush vs one flush
+                        per commit; speedup = per-commit wall / streamed
+                        wall, plus the same planner telemetry
   fastsync_replay     — blocks x validators batched replay (config 4)
   mixed_streaming     — ed25519+sr25519 mixed 10k set (config 5)
   streaming_{n}_sigs_per_sec — sustained sigs/s, pipelined RLC batches
@@ -605,6 +617,192 @@ def bench_catchup(n_blocks: int = 48, n_vals: int = 128, super_batch: int = 16):
         "speedup": round(pipelined_bps / serial_bps, 2),
         "speedup_vs_per_block": round(pipelined_bps / per_block_bps, 2),
     }
+
+
+def _tiled_batch(n: int, base: int):
+    """n signed rows tiled from `base` distinct signed triples: pure-Python
+    signing costs ~4 ms/row on wheel-less hosts, so the jumbo scenarios
+    sign a base set and tile it — verification work is identical per row
+    (the streamed plain kernel decompresses in-kernel per chunk), and the
+    result records `tiled_from` so the ledger knows."""
+    pk_b, msg_b, sig_b, _ = make_batch(min(n, base))
+    reps = -(-n // len(pk_b))
+    return (pk_b * reps)[:n], (msg_b * reps)[:n], (sig_b * reps)[:n], pk_b, msg_b, sig_b
+
+
+def bench_verify_commit_100k(
+    n: int = 100_000, base: int = 4096, sample: int | None = None,
+    backend: str | None = "jax", serial_n: int = 256,
+):
+    """ISSUE 13 — the streamed flush planner's headline workload: ONE
+    100k-validator commit (~200k MSM lanes, far past the lane-bucket
+    ladder) verified as fixed-bucket chunks streamed through the RLC
+    pipeline with double-buffered host prep and on-device partial
+    accumulation. Reports the streamed e2e wall, the planner's chunk
+    telemetry (chunks / chunk_lanes / peak lanes in flight — the
+    double-buffer bound the acceptance pins at 2x the chunk bucket),
+    slope-methodology RAW samples over chained streamed flushes, and
+    `speedup` vs the extrapolated serial baseline. The CPU-fallback variant
+    measures the same body on a `sample` subset through the chunked
+    host-RLC path (this host's fast path) and extrapolates linearly."""
+    from tendermint_tpu.crypto import batch as B
+
+    rows = sample or n
+    log(f"[verify_commit_100k] building {min(rows, base)} signed triples "
+        f"(tiled to {rows})...")
+    pubkeys, msgs, sigs, pk_b, msg_b, sig_b = _tiled_batch(rows, base)
+    sn = min(serial_n, len(pk_b))
+    cpu_s = time_cpu_serial(pk_b[:sn], msg_b[:sn], sig_b[:sn]) * (n / sn)
+
+    log(f"[verify_commit_100k] serial baseline {cpu_s:.1f} s (extrapolated); "
+        f"running streamed flushes...")
+    first = best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        mask = B.verify_batch(pubkeys, msgs, sigs, backend=backend)
+        dt = time.perf_counter() - t0
+        assert mask.all()
+        if first is None:
+            first = dt
+        best = dt if best is None else min(best, dt)
+    det = dict(B.LAST_FLUSH_DETAIL)
+    scale = n / rows
+    e2e = best * scale
+    # slope-methodology raw samples: k chained streamed flushes (each flush
+    # syncs internally at its chunk cadence; the slope is the honest
+    # per-super-batch number through a high-RTT tunnel)
+    samples = []
+    for k in (1, 2):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            assert B.verify_batch(pubkeys, msgs, sigs, backend=backend).all()
+        samples.append([k, round(time.perf_counter() - t0, 6)])
+    slope_ms = (samples[1][1] - samples[0][1]) * 1e3 * scale
+    chunk_lanes = det.get("chunk_lanes") or B.planner_budget()
+    # planner-side accounting, absent on paths that don't stream device
+    # chunks (host-RLC): report None, never a vacuous pass — the
+    # independent throttle-order pin lives in tests/test_flush_planner.py
+    peak = det.get("peak_lanes_in_flight")
+    out = {
+        "n": n,
+        "tiled_from": len(pk_b),
+        "cpu_serial_ms": round(cpu_s * 1e3, 3),
+        "tpu_e2e_ms": round(e2e * 1e3, 3),
+        "first_ms": round(first * scale * 1e3, 3),
+        "sigs_per_sec_e2e": round(n / e2e),
+        "speedup_e2e": round(cpu_s / e2e, 2),
+        "speedup": round(cpu_s / e2e, 2),
+        "slope_samples": samples,
+        "pipelined_slope_ms": round(slope_ms, 3),
+        "planner_budget": B.planner_budget(),
+        "chunks": det.get("chunks"),
+        "chunk_lanes": det.get("chunk_lanes"),
+        "prep_overlap_ms": round((det.get("prep_overlap_s") or 0.0) * 1e3, 3),
+        "peak_lanes_in_flight": peak,
+        # the double-buffer bound: lanes in flight never exceed 2 chunks
+        # (None = not measured on this path, NOT a pass)
+        "lanes_in_flight_ok": (
+            bool(peak <= 2 * chunk_lanes) if peak is not None else None
+        ),
+        "host_rlc": bool(det.get("host_rlc")),
+    }
+    if rows != n:
+        out["sample_n"] = rows
+    log(f"[verify_commit_100k] streamed e2e {e2e*1e3:.1f} ms "
+        f"({out['chunks']} chunks), speedup {out['speedup']}x")
+    return out
+
+
+def bench_super_batch(
+    n_blocks: int = 16, n_vals: int = 1024, base_blocks: int = 4,
+    backend: str | None = "jax", serial_n: int = 256,
+):
+    """ISSUE 13 — multi-commit super-batch: commits for H heights x V
+    validators verified as ONE streamed cross-height flush (the shape
+    blocksync's raised 64-block run cap feeds through the scheduler's
+    catch-up lane) vs one flush per commit (the pre-planner loop).
+    `speedup` = per-commit wall over streamed wall; slope samples ride the
+    streamed arm. Rows tile `base_blocks` distinct signed commit row sets
+    across the H heights (signing cost, see _tiled_batch)."""
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.crypto.keys import gen_ed25519
+
+    rng = np.random.default_rng(4321)
+    privs = [
+        gen_ed25519(rng.integers(0, 256, 32, dtype=np.uint8).tobytes())
+        for _ in range(n_vals)
+    ]
+    pks = [p.pub_key().bytes() for p in privs]
+    nb_base = min(base_blocks, n_blocks)
+    log(f"[super_batch] signing {nb_base}x{n_vals} commit rows "
+        f"(tiled to {n_blocks} heights)...")
+    block_msgs, block_sigs = [], []
+    for b in range(nb_base):
+        ms = [b"sb%04d|vote%06d-signbytes-padding" % (b, i) for i in range(n_vals)]
+        block_msgs.append(ms)
+        block_sigs.append([p.sign(m) for p, m in zip(privs, ms)])
+    blocks = [(block_msgs[b % nb_base], block_sigs[b % nb_base]) for b in range(n_blocks)]
+
+    sn = min(serial_n, n_vals)
+    cpu_s = time_cpu_serial(pks[:sn], block_msgs[0][:sn], block_sigs[0][:sn])
+    serial_s = cpu_s * (n_vals / sn) * n_blocks
+
+    # warm BOTH arms before timing either: one untimed per-commit flush
+    # pays the one-time costs (kernel compile at the commit's lane bucket,
+    # cold A-cache / host point-cache fill) that would otherwise land in
+    # the per-commit arm only — while the streamed arm's marginal sample
+    # below strips its own — biasing `speedup` upward
+    assert B.verify_batch(pks, blocks[0][0], blocks[0][1], backend=backend).all()
+
+    # per-commit arm: one flush per height (the pre-planner shape)
+    t0 = time.perf_counter()
+    for ms, sg in blocks:
+        assert B.verify_batch(pks, ms, sg, backend=backend).all()
+    per_commit_s = time.perf_counter() - t0
+
+    # streamed arm: ONE cross-height flush through the planner
+    pk_rows = [pk for _ in blocks for pk in pks]
+    msg_rows = [m for ms, _ in blocks for m in ms]
+    sig_rows = [s for _, sg in blocks for s in sg]
+    samples = []
+    streamed_s = None
+    for k in (1, 2):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            assert B.verify_batch(pk_rows, msg_rows, sig_rows, backend=backend).all()
+        dt = time.perf_counter() - t0
+        samples.append([k, round(dt, 6)])
+        if k == 1:
+            streamed_s = dt
+    det = dict(B.LAST_FLUSH_DETAIL)
+    streamed_s = min(streamed_s, samples[1][1] - samples[0][1])
+    chunk_lanes = det.get("chunk_lanes") or B.planner_budget()
+    peak = det.get("peak_lanes_in_flight")  # None = path didn't measure it
+    out = {
+        "n_blocks": n_blocks,
+        "n_vals": n_vals,
+        "rows": len(pk_rows),
+        "serial_s": round(serial_s, 3),
+        "per_commit_commits_per_sec": round(n_blocks / per_commit_s, 3),
+        "streamed_commits_per_sec": round(n_blocks / streamed_s, 3),
+        "sigs_per_sec": round(len(pk_rows) / streamed_s),
+        "speedup": round(per_commit_s / streamed_s, 2),
+        "speedup_vs_serial": round(serial_s / streamed_s, 2),
+        "slope_samples": samples,
+        "planner_budget": B.planner_budget(),
+        "chunks": det.get("chunks"),
+        "chunk_lanes": det.get("chunk_lanes"),
+        "prep_overlap_ms": round((det.get("prep_overlap_s") or 0.0) * 1e3, 3),
+        "peak_lanes_in_flight": peak,
+        "lanes_in_flight_ok": (
+            bool(peak <= 2 * chunk_lanes) if peak is not None else None
+        ),
+        "host_rlc": bool(det.get("host_rlc")),
+    }
+    log(f"[super_batch] per-commit {n_blocks/per_commit_s:.2f} commits/s, "
+        f"streamed {n_blocks/streamed_s:.2f} commits/s "
+        f"({out['chunks']} chunks) — {out['speedup']}x")
+    return out
 
 
 def bench_vote_storm(n_vals: int = 1024, heights: int = 4):
@@ -1732,6 +1930,8 @@ _SCENARIO_PLAN = [
     ("verify_commit_1k", 420.0, 700.0),
     ("light_trusting_4k", 420.0, 700.0),
     ("verify_commit_10k", 420.0, 800.0),
+    ("verify_commit_100k", 120.0, 700.0),
+    ("super_batch", 90.0, 500.0),
     ("streaming", 120.0, 400.0),
     ("fastsync_replay", 240.0, 500.0),
     ("catchup", 90.0, 400.0),
@@ -1768,6 +1968,8 @@ def _scenario_fns() -> dict:
         "n": stream_n,
         "sigs_per_sec": round(bench_streaming(stream_n)),
     }
+    fns["verify_commit_100k"] = bench_verify_commit_100k
+    fns["super_batch"] = bench_super_batch
     fns["fastsync_replay"] = bench_fastsync_replay
     fns["catchup"] = bench_catchup
     fns["mixed_streaming"] = bench_mixed_streaming
@@ -1818,6 +2020,15 @@ def _cpu_fallback_fns() -> dict:
     # catchup's real body is backend-agnostic (verify_batch routes to the
     # CPU host-RLC path in the fallback child): smaller sizes, same arms
     fns["catchup"] = lambda: bench_catchup(n_blocks=32, n_vals=128, super_batch=16)
+    # the planner scenarios run their real bodies on the chunked host-RLC
+    # path (this container's fast path): smaller samples, linear
+    # extrapolation marked via sample_n / tiled_from
+    fns["verify_commit_100k"] = lambda: bench_verify_commit_100k(
+        base=1024, sample=16384, backend=None
+    )
+    fns["super_batch"] = lambda: bench_super_batch(
+        n_blocks=8, n_vals=2048, base_blocks=1, backend=None
+    )
     # host-side scenarios run their real body on the CPU backend
     fns["vote_storm"] = lambda: bench_vote_storm(n_vals=256, heights=2)
     fns["overload"] = bench_overload
@@ -1951,6 +2162,17 @@ def _run_scenario_child(name: str, deadline_s: float, degraded: bool = False,
     import signal as _signal
     import subprocess
 
+    if not degraded and os.environ.get("TMTPU_BENCH_NO_DEVICE") == "1":
+        # accelerator-less host, declared up front: skip the doomed device
+        # child (XLA:CPU pays multi-minute compiles per shape just to time
+        # out) and let the caller degrade straight to the clearly-marked
+        # CPU fallback — every scenario still lands a parseable datapoint
+        return {
+            "scenario": name,
+            "ok": False,
+            "error": "device attempt skipped (TMTPU_BENCH_NO_DEVICE=1)",
+        }
+
     if os.environ.get("TMTPU_BENCH_INPROC") == "1":
         # test/debug escape hatch: no isolation, same protocol
         import contextlib
@@ -2042,12 +2264,40 @@ def _run_scenario_child(name: str, deadline_s: float, degraded: bool = False,
     return rep
 
 
+def _headline_scenario():
+    """The config whose latency is the round's headline metric. ONE source
+    of truth with the ledger's headline-missing flag
+    (tendermint_tpu/tools/perf_ledger.HEADLINE_SCENARIO) — two independent
+    notions of 'the headline' would re-open the silent-gap failure this
+    exists to close. Falls back to the largest _CONFIG_SIZES entry when
+    the registry doesn't carry the production headline (harness tests
+    monkeypatch _CONFIG_SIZES)."""
+    try:
+        from tendermint_tpu.tools.perf_ledger import HEADLINE_SCENARIO
+
+        if HEADLINE_SCENARIO in _CONFIG_SIZES:
+            return HEADLINE_SCENARIO
+    except Exception:
+        pass
+    names = list(_CONFIG_SIZES)
+    return names[-1] if names else None
+
+
 def _plan() -> list:
     names = os.environ.get("TMTPU_BENCH_SCENARIOS")
     if not names:
         return list(_SCENARIO_PLAN)
     by_name = {n: (n, need, dl) for n, need, dl in _SCENARIO_PLAN}
-    return [by_name.get(n, (n, 0.0, 120.0)) for n in names.split(",") if n]
+    plan = [by_name.get(n, (n, 0.0, 120.0)) for n in names.split(",") if n]
+    # The HEADLINE config rides EVERY plan: BENCH_r06 was a catchup-scoped
+    # round that silently lost the verify_commit_10k trajectory point; a
+    # scenario-scoped override now prepends the headline instead of
+    # dropping it (tools/perf_ledger.py flags any round that still lacks
+    # it — belt and braces).
+    head = _headline_scenario()
+    if head is not None and not any(p[0] == head for p in plan):
+        plan.insert(0, by_name.get(head, (head, 0.0, 800.0)))
+    return plan
 
 
 def main():

@@ -35,7 +35,11 @@ from typing import Callable, Dict, Optional
 
 logger = logging.getLogger("tendermint_tpu.blocksync")
 
-REQUEST_WINDOW = 40  # max heights in flight (reference: maxPendingRequests-ish)
+# Max heights in flight (reference: maxPendingRequests-ish). Sized to feed
+# the reactor's 64-block super-batch runs (VERIFY_BATCH_BLOCKS) with
+# fetch-ahead to spare — a window smaller than the run cap can never
+# assemble a full run, silently shrinking every super-batch.
+REQUEST_WINDOW = 96
 # defaults for the [fastsync] peer_timeout / retry_sleep config knobs
 # (kept as module constants for tests and non-config callers)
 PEER_TIMEOUT = 10.0

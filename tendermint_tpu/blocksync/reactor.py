@@ -56,7 +56,15 @@ logger = logging.getLogger("tendermint_tpu.blocksync")
 BLOCKSYNC_CHANNEL = 0x40
 STATUS_UPDATE_INTERVAL = 2.0
 SWITCH_TO_CONSENSUS_INTERVAL = 0.5
-VERIFY_BATCH_BLOCKS = 16
+# Super-batch run cap. 16 until ISSUE 13: the cap existed to bound the ONE
+# device flush a run produced (16 blocks x 10k validators already brushed
+# the lane-bucket ceiling). The flush planner now bounds device memory at
+# its chunk budget regardless of flush size (crypto/batch.py
+# max_flush_lanes — the scheduler's catch-up lane also splits oversized
+# flushes into planner chunks with a vote-preemption point between them),
+# so the run length is free to grow: longer runs amortize per-flush prep
+# and give the cross-height batch more rows to collapse per signer.
+VERIFY_BATCH_BLOCKS = 64
 # verified-but-unapplied blocks the pipeline may hold (backpressure bound:
 # verify never runs more than ~2 super-batches ahead of apply)
 PIPELINE_WINDOW = 2 * VERIFY_BATCH_BLOCKS

@@ -396,6 +396,14 @@ class CryptoConfig:
     # health-probe backoff while OPEN: base doubles per failed probe up to max
     breaker_probe_base: float = 1.0
     breaker_probe_max: float = 60.0
+    # Streamed flush planner (crypto/batch.py, ISSUE 13): row sets whose
+    # lane count would exceed this device budget split into fixed-bucket
+    # chunks streamed double-buffered through the RLC pipeline with
+    # on-device partial accumulation — a 100k-validator commit (or a
+    # 64-block catch-up super-batch) runs at CONSTANT device footprint
+    # instead of compiling an unbounded one-off shape. Lanes = 2*rows + 1;
+    # the default matches the 10k-commit steady-state bucket.
+    max_flush_lanes: int = 24576
 
 
 @dataclass

@@ -144,6 +144,94 @@ def _lane_bucket(m: int) -> int:
 # latency is fine and each extra RLC shape costs a long one-time compile.
 RLC_MIN = int(os.environ.get("TMTPU_RLC_MIN", "512"))
 
+# ---------------------------------------------------------------------------
+# Streamed flush planner (ISSUE 13). The lane-bucket ladder above tops out at
+# 32,768 lanes; anything larger used to fall into an unbounded one-off
+# compile whose device temp footprint scales with the workload (a
+# 100k-validator commit is ~200k lanes, ~10x the 10k commit's footprint).
+# The RLC combined check is a SUM over lanes, so an arbitrarily large flush
+# decomposes exactly into fixed-bucket chunks: each chunk runs the full
+# Pippenger pipeline WITHOUT the identity check (ops/msm_jax.py
+# rlc_partial_submit), partial points accumulate ON DEVICE via a tiny padd
+# fold, and one identity check at the end delivers the combined verdict —
+# workload size unbounded, device footprint constant at the chunk bucket.
+#
+# Chunks stream DOUBLE-BUFFERED: the native C host prep (hashing, scalars,
+# window sort) of chunk k+1 runs on a prep worker thread while chunk k's
+# kernels execute, and a chunk's lane-validity sync throttles submission so
+# lanes in flight never exceed 2 chunks. Each chunk carries its own B lane
+# with scalar (L - u_k): the basepoint has order L, so the per-chunk B terms
+# sum to the single flush's one ((L - Σu_k) mod L)·B term exactly — the
+# combined-check verdict, the exact-mask failure recovery, and every
+# consumer's verdict slice are byte-identical to a hypothetical single
+# flush. Config: `[crypto] max_flush_lanes` (node/node.py configure_planner).
+
+def _planner_env_default() -> int:
+    """TMTPU_MAX_FLUSH_LANES with the SAME normalization configure_planner
+    enforces (floor 8, even) — a degenerate env value must not ship a
+    planner whose chunk size is zero or negative."""
+    try:
+        v = int(os.environ.get("TMTPU_MAX_FLUSH_LANES", "24576"))
+    except ValueError:
+        v = 24576
+    return max(8, v) & ~1
+
+
+_PLANNER = {"max_flush_lanes": _planner_env_default()}
+
+
+def configure_planner(max_flush_lanes: int | None = None) -> None:
+    """Apply `[crypto]` planner config (node/node.py). Process-global, last
+    node wins — the same model as the breaker and the verify mode."""
+    if max_flush_lanes is not None:
+        v = int(max_flush_lanes)
+        if v < 8:
+            # 8 is the structural floor (>= 1 row + B lane per half);
+            # production budgets live at bucket scale (default 24576)
+            raise ValueError(f"max_flush_lanes {v} < 8")
+        _PLANNER["max_flush_lanes"] = v & ~1  # even: A block + R block
+
+
+def planner_budget() -> int:
+    """Device budget per flush, in MSM lanes (A + B + R + pads)."""
+    return _PLANNER["max_flush_lanes"]
+
+
+def planner_chunk_rows() -> int:
+    """Signature rows per streamed chunk: half the lane budget is the A
+    block (rows + this chunk's B lane), the other half the R block."""
+    return planner_budget() // 2 - 1
+
+
+def planner_engaged(n: int) -> bool:
+    """Does an n-row flush stream through the planner? True exactly when a
+    single flush would exceed the lane budget."""
+    return n > planner_chunk_rows()
+
+
+def _planner_chunks(n: int) -> list:
+    """[(lo, hi), ...] row spans; every chunk pads to the SAME lane bucket
+    (one warm compiled shape — prewarm covers it), ragged tail included."""
+    c = planner_chunk_rows()
+    return [(lo, min(lo + c, n)) for lo in range(0, n, c)]
+
+
+_PREP_POOL = None  # lazy single-thread executor: the planner's prep worker
+_PREP_POOL_LOCK = threading.Lock()
+
+
+def _prep_pool():
+    global _PREP_POOL
+    if _PREP_POOL is None:
+        with _PREP_POOL_LOCK:
+            if _PREP_POOL is None:  # two first-streamed-flush threads racing
+                from concurrent.futures import ThreadPoolExecutor
+
+                _PREP_POOL = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="flush-prep"
+                )
+    return _PREP_POOL
+
 # Below this, auto-selected "jax" routes to the host loop instead. A one-shot
 # small batch is round-trip-latency-bound (the device answer costs ~2 RTT +
 # dispatch regardless of size), so the crossover vs the ~115us/sig host loop
@@ -259,8 +347,23 @@ def _verify_batch_cpu_rlc(pubkeys, msgs, sigs) -> Optional[np.ndarray]:
     with w_i = z_i·h_i mod 8L, u = Σ z_i·s_i mod L — the exact device-RLC
     equation (_rlc_submit) on host points. Returns the mask when the
     combined check passes; None = caller must fall back to the serial loop
-    (a row failed, or an exceptional addition produced Z == 0)."""
-    from tendermint_tpu.crypto.ed25519_ref import BASE, IDENTITY, P, point_equal
+    (a row failed, or an exceptional addition produced Z == 0).
+
+    CHUNKED at the flush planner's budget (ISSUE 13): rows past
+    planner_chunk_rows() stream as fixed-size partial Pippenger MSMs summed
+    with point_add — a 100k-row flush on a wheel-less host never
+    materializes the whole decompressed point set at once (the
+    decompressed-point cache _HOST_PT_CACHE is shared across chunks, so
+    repeated signers decompress once per flush regardless of chunking).
+    Per-chunk coefficient collapse + the per-chunk B term keep the
+    accumulated sum exactly equal to the single-MSM equation."""
+    from tendermint_tpu.crypto.ed25519_ref import (
+        BASE,
+        IDENTITY,
+        P,
+        point_add,
+        point_equal,
+    )
 
     from tendermint_tpu import native
 
@@ -285,42 +388,60 @@ def _verify_batch_cpu_rlc(pubkeys, msgs, sigs) -> Optional[np.ndarray]:
         precheck, _a_rows, _r_rows, s_ints, hk_ints = _precheck_and_hash(
             pubkeys, msgs, sigs
         )
-    a_pts = [None] * n
-    r_pts = [None] * n
-    for i in range(n):
-        if not precheck[i]:
-            continue
-        a = _host_point(bytes(pubkeys[i]))
-        r = _host_point(bytes(sigs[i])[:32])
-        if a is None or r is None:
-            precheck[i] = False
-            continue
-        a_pts[i] = a
-        r_pts[i] = r
-    if not precheck.any():
-        return precheck  # nothing verifiable: every verdict already False
     rng = np.random.default_rng()  # OS-entropy seeded per call
     zs = _sample_z(rng, n, precheck)
-    # A-lane coefficients collapse per DISTINCT pubkey (mod 8L is exact):
-    # the admission workload verifies many txs from few signers, and one
-    # combined lane per signer cuts the MSM's digit adds accordingly
-    a_coef: dict = {}
-    a_by_key: dict = {}
-    pairs = []
-    u = 0
-    for i in range(n):
-        if not precheck[i]:
+    chunk = planner_chunk_rows()
+    acc = None
+    n_chunks = 0
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        n_chunks += 1
+        # decompress THIS chunk's points only (cache-backed, write-shared
+        # across chunks and flushes); invalid encodings drop out of
+        # precheck exactly as on the device paths
+        r_pts = [None] * (hi - lo)
+        a_pts = [None] * (hi - lo)
+        for i in range(lo, hi):
+            if not precheck[i]:
+                continue
+            a = _host_point(bytes(pubkeys[i]))
+            r = _host_point(bytes(sigs[i])[:32])
+            if a is None or r is None:
+                precheck[i] = False
+                continue
+            a_pts[i - lo] = a
+            r_pts[i - lo] = r
+        # A-lane coefficients collapse per DISTINCT pubkey (mod 8L is
+        # exact): the admission workload verifies many txs from few
+        # signers, and one combined lane per signer cuts the MSM's digit
+        # adds accordingly
+        a_coef: dict = {}
+        a_by_key: dict = {}
+        pairs = []
+        u = 0
+        for i in range(lo, hi):
+            if not precheck[i]:
+                continue
+            pkb = bytes(pubkeys[i])
+            a_coef[pkb] = (a_coef.get(pkb, 0) + zs[i] * hk_ints[i]) % L8
+            a_by_key[pkb] = a_pts[i - lo]
+            pairs.append((r_pts[i - lo], zs[i]))
+            u += zs[i] * s_ints[i]
+        if not pairs:
             continue
-        pkb = bytes(pubkeys[i])
-        a_coef[pkb] = (a_coef.get(pkb, 0) + zs[i] * hk_ints[i]) % L8
-        a_by_key[pkb] = a_pts[i]
-        pairs.append((r_pts[i], zs[i]))
-        u += zs[i] * s_ints[i]
-    pairs.extend((a_by_key[pkb], c) for pkb, c in a_coef.items())
-    pairs.append((BASE, (L - u % L) % L))
-    res = _host_msm(pairs)
-    if res is None:
-        res = IDENTITY
+        pairs.extend((a_by_key[pkb], c) for pkb, c in a_coef.items())
+        # the chunk's own B term: Σ_k (L - u_k) ≡ L - Σ u_k (mod L), so
+        # the accumulated sum equals the single-flush equation exactly
+        pairs.append((BASE, (L - u % L) % L))
+        part = _host_msm(pairs)
+        if part is not None:
+            acc = part if acc is None else point_add(acc, part)
+    if not precheck.any():
+        return precheck  # nothing verifiable: every verdict already False
+    if n_chunks > 1:
+        LAST_FLUSH_DETAIL["chunks"] = n_chunks
+        LAST_FLUSH_DETAIL["chunk_lanes"] = 2 * (chunk + 1)
+    res = acc if acc is not None else IDENTITY
     if res[2] % P == 0:
         # exceptional unified addition on crafted torsion inputs — the
         # device kernels read this as REJECT; here the serial loop decides
@@ -1013,6 +1134,339 @@ def _rlc_finish_many(calls: Sequence[_RlcCall]) -> List[Optional[np.ndarray]]:
     return [_rlc_finish(c) for c in calls]
 
 
+def _prep_stream_chunk(
+    pubkeys, msgs, sigs, lo: int, hi: int, na_c: int, sort: bool = True
+):
+    """Host prep of ONE planner chunk, plain-kernel lane layout:
+    [A_lo..A_{hi-1}, B, pads -> na_c | R_lo..R_{hi-1}, pads -> na_c], with
+    the chunk's own B-lane scalar (L - u_k) mod L (see the planner note
+    above: per-chunk B terms sum exactly). Runs on the prep worker thread —
+    it must touch no shared mutable state beyond the (locked) caches.
+
+    Returns (precheck (hi-lo,) bool, pts (2*na_c, 32) u8, scalars,
+    prep_seconds)."""
+    t0 = time.perf_counter()
+    from tendermint_tpu.crypto.ed25519_ref import BASE, point_compress
+
+    from tendermint_tpu import native
+
+    pk, mg, sg = pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi]
+    c = hi - lo
+    if native.available():
+        precheck, a_rows, r_rows, s_rows, h_rows = _precheck_and_hash_fast(
+            pk, mg, sg
+        )
+        z16, w_rows, u = _rlc_scalars_fast(precheck, s_rows, h_rows)
+        scalars = np.zeros((2 * na_c, 32), dtype=np.uint8)
+        scalars[:c] = w_rows
+        scalars[c] = np.frombuffer(
+            ((L - u) % L).to_bytes(32, "little"), dtype=np.uint8
+        )
+        scalars[na_c : na_c + c, :16] = z16  # zeroed where ~precheck
+    else:
+        precheck, a_rows, r_rows, s_ints, hk_ints = _precheck_and_hash(
+            pk, mg, sg
+        )
+        zs, w_scalars, u = _rlc_scalars(precheck, s_ints, hk_ints, c)
+        scalars = [0] * (2 * na_c)
+        scalars[:c] = w_scalars
+        scalars[c] = (L - u) % L
+        scalars[na_c : na_c + c] = [
+            zs[i] if precheck[i] else 0 for i in range(c)
+        ]
+    b_enc = np.frombuffer(point_compress(BASE), dtype=np.uint8)
+    pts = np.tile(b_enc, (2 * na_c, 1))
+    if precheck.any():
+        pts[:c][precheck] = a_rows[precheck]
+        pts[na_c : na_c + c][precheck] = r_rows[precheck]
+    # the window sort belongs to the PREP worker too (it is the largest
+    # single host-prep cost at chunk scale — overlapping hashing but not
+    # the sort would leave the dispatch thread sort-bound between chunks);
+    # the sharded arm sorts per shard in prepare_rlc_shards instead
+    presorted = None
+    if sort:
+        from tendermint_tpu.ops.msm_jax import scalars_to_bytes, sort_windows
+
+        digits = scalars_to_bytes(scalars, 2 * na_c)
+        presorted = sort_windows(digits, zero16_from=na_c)
+    return precheck, pts, scalars, presorted, time.perf_counter() - t0
+
+
+def _prep_stream_chunk_sharded(
+    pubkeys, msgs, sigs, lo: int, hi: int, na_c: int, nd: int
+):
+    """Sharded-arm prep worker task: chunk prep + the per-shard lane split
+    AND per-shard window sorts (prepare_rlc_shards) — all off the
+    submitting thread, so the mesh dispatch cadence is kernel-bound."""
+    from tendermint_tpu.parallel.sharded import prepare_rlc_shards
+
+    t0 = time.perf_counter()
+    precheck, pts, scalars, _, _ = _prep_stream_chunk(
+        pubkeys, msgs, sigs, lo, hi, na_c, sort=False
+    )
+    shards = prepare_rlc_shards(pts, scalars, nd)
+    return precheck, shards, time.perf_counter() - t0
+
+
+def _verify_batch_rlc_streamed(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> Optional[np.ndarray]:
+    """The streamed RLC combined check (see the planner note): fixed-bucket
+    chunks through rlc_partial_submit, double-buffered host prep, on-device
+    partial accumulation, one identity check. Returns the mask when the
+    combined check passes, None -> the caller recovers the exact per-row
+    mask chunk by chunk."""
+    from collections import deque
+
+    from tendermint_tpu.ops import msm_jax
+
+    _device_fault("rlc_submit")
+    t0 = time.perf_counter()
+    msm_jax._set_submit_fused(False)
+    counters0 = dict(msm_jax.flush_counters())
+    n = len(pubkeys)
+    na_c = planner_budget() // 2
+    chunks = _planner_chunks(n)
+    pool = _prep_pool()
+    prechecks: list = [None] * len(chunks)
+    acc = None
+    inflight: deque = deque()  # (chunk idx, unsynced lane-validity array)
+    lanes_ok = [True]
+    prep_total = [0.0]
+    overlap_s = [0.0]
+    peak_lanes = [0]
+
+    def _sync_oldest():
+        k, dev_ok = inflight.popleft()
+        _device_fault("rlc_finish")
+        ok = np.asarray(dev_ok)  # blocks until chunk k's kernels land
+        pc = prechecks[k]
+        c = chunks[k][1] - chunks[k][0]
+        if pc.any() and not (
+            ok[:c][pc].all() and ok[na_c : na_c + c][pc].all()
+        ):
+            lanes_ok[0] = False
+
+    fut = pool.submit(
+        _prep_stream_chunk, pubkeys, msgs, sigs, *chunks[0], na_c
+    )
+    for k in range(len(chunks)):
+        t_wait = time.perf_counter()
+        precheck, pts, scalars, presorted, prep_s = fut.result()
+        blocked = time.perf_counter() - t_wait
+        prep_total[0] += prep_s
+        if k > 0:
+            # the slice of this chunk's prep that ran while the previous
+            # chunk's kernels were executing (the double buffer's win)
+            overlap_s[0] += max(0.0, prep_s - blocked)
+        prechecks[k] = precheck
+        if k + 1 < len(chunks):
+            fut = pool.submit(
+                _prep_stream_chunk, pubkeys, msgs, sigs, *chunks[k + 1], na_c
+            )
+        part, dev_ok = msm_jax.rlc_partial_submit(
+            pts, scalars, zero16_from=na_c, presorted=presorted
+        )
+        # device-resident accumulation: one tiny padd fold per chunk; the
+        # chunk's big intermediates die with its kernel, only the (4, 20)
+        # accumulator and the lane flags persist
+        acc = part if acc is None else msm_jax.partial_fold_submit(acc, part)
+        inflight.append((k, dev_ok))
+        # planner-side accounting of submitted-but-unsynced chunks (an
+        # independent throttle-order witness lives in
+        # tests/test_flush_planner.py's outstanding-submission tracker)
+        peak_lanes[0] = max(peak_lanes[0], len(inflight) * 2 * na_c)
+        if len(inflight) >= 2:
+            # throttle: sync the older chunk's flags before submitting the
+            # next — lanes in flight are bounded at 2 chunks, never more
+            _sync_oldest()
+    while inflight:
+        _sync_oldest()
+    t_sync = time.perf_counter()
+    try:
+        _device_fault("rlc_finish")
+        batch_ok = bool(np.asarray(msm_jax.partial_identity_submit(acc)))
+    except Exception as e:
+        _trace.mark_device_call(ok=False, error=repr(e))
+        raise
+    _trace.mark_device_call(ok=True)
+    _record_submit_counters(msm_jax, counters0)
+    LAST_FLUSH_DETAIL.update(
+        jit_bucket=na_c,
+        padding_lanes=len(chunks) * 2 * na_c - (2 * n + len(chunks)),
+        chunks=len(chunks),
+        chunk_lanes=2 * na_c,
+        prep_s=prep_total[0],
+        prep_overlap_s=overlap_s[0],
+        peak_lanes_in_flight=peak_lanes[0],
+        transfer_s=time.perf_counter() - t_sync,
+    )
+    LAST_RLC_TIMINGS.update(
+        prep_ms=prep_total[0] * 1e3,
+        total_ms=(time.perf_counter() - t0) * 1e3,
+        cached=False,
+        mode="streamed",
+    )
+    if batch_ok and lanes_ok[0]:
+        return np.concatenate(prechecks)
+    return None
+
+
+def _verify_batch_rlc_sharded_streamed(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> Optional[np.ndarray]:
+    """The planner's multi-chip arm: fixed-bucket chunks stream ACROSS the
+    mesh (parallel/sharded.sharded_rlc_stream) — per-shard lane slices via
+    prepare_rlc_shards with chunk-multiple padding per shard, per-shard
+    device-resident partial accumulation, ONE all_gather at the end. Host
+    prep double-buffers exactly like the single-device arm. Returns the
+    mask, or None -> chunked exact recovery in the caller."""
+    from collections import deque
+
+    env = _sharded_env()
+    if env is None:
+        return None
+    nd = env[0]
+    run_chunk, finish = env[3]
+    n = len(pubkeys)
+    na_c = planner_budget() // 2
+    while (2 * na_c) % nd:
+        na_c += 1  # per-shard lane slices must tile the mesh exactly
+    chunks = _planner_chunks(n)
+    from tendermint_tpu.parallel import telemetry as _mesh_tm
+
+    _mesh_tm.record_pad(
+        requested_lanes=2 * n + len(chunks),
+        padded_lanes=len(chunks) * 2 * na_c,
+    )
+    pool = _prep_pool()
+    prechecks: list = [None] * len(chunks)
+    inflight: deque = deque()
+    lanes_ok = [True]
+    prep_total = [0.0]
+    overlap_s = [0.0]
+    peak_lanes = [0]
+
+    def _sync_oldest():
+        k, dev_ok = inflight.popleft()
+        _device_fault("rlc_finish")
+        ok = np.asarray(dev_ok).reshape(-1)
+        pc = prechecks[k]
+        c = chunks[k][1] - chunks[k][0]
+        if pc.any() and not (
+            ok[:c][pc].all() and ok[na_c : na_c + c][pc].all()
+        ):
+            lanes_ok[0] = False
+
+    try:
+        acc = None
+        fut = pool.submit(
+            _prep_stream_chunk_sharded, pubkeys, msgs, sigs, *chunks[0],
+            na_c, nd,
+        )
+        for k in range(len(chunks)):
+            t_wait = time.perf_counter()
+            precheck, shards, prep_s = fut.result()
+            blocked = time.perf_counter() - t_wait
+            prep_total[0] += prep_s
+            if k > 0:
+                overlap_s[0] += max(0.0, prep_s - blocked)
+            prechecks[k] = precheck
+            if k + 1 < len(chunks):
+                fut = pool.submit(
+                    _prep_stream_chunk_sharded, pubkeys, msgs, sigs,
+                    *chunks[k + 1], na_c, nd,
+                )
+            acc, dev_ok = run_chunk(*shards, acc)
+            inflight.append((k, dev_ok))
+            peak_lanes[0] = max(peak_lanes[0], len(inflight) * 2 * na_c)
+            if len(inflight) >= 2:
+                _sync_oldest()
+        while inflight:
+            _sync_oldest()
+        batch_ok = bool(np.asarray(finish(acc)))
+    except Exception:
+        import logging
+
+        logging.getLogger("tendermint_tpu.crypto.batch").exception(
+            "sharded streamed RLC failed; recovering chunk by chunk"
+        )
+        return None
+    LAST_FLUSH_DETAIL.update(
+        jit_bucket=na_c,
+        padding_lanes=len(chunks) * 2 * na_c - (2 * n + len(chunks)),
+        chunks=len(chunks),
+        chunk_lanes=2 * na_c,
+        prep_s=prep_total[0],
+        prep_overlap_s=overlap_s[0],
+        peak_lanes_in_flight=peak_lanes[0],
+    )
+    if batch_ok and lanes_ok[0]:
+        return np.concatenate(prechecks)
+    return None
+
+
+def _verify_batch_streamed(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> np.ndarray:
+    """Planner-engaged verification (row count above the chunk budget):
+    streamed combined check first; on failure (a bad signature somewhere, an
+    invalid encoding, or a device error) recover the EXACT per-row mask one
+    planner chunk at a time through the normal verify_batch_jax ladder —
+    each recovery chunk is at most the budget, so even the failure path
+    never materializes an over-budget device shape."""
+    from tendermint_tpu.ops import msm_jax
+
+    tr = _trace.tracer if _trace.tracer.enabled else None
+    mask = None
+    if _sharded_env() is not None:
+        mask = _verify_batch_rlc_sharded_streamed(pubkeys, msgs, sigs)
+        if mask is not None:
+            LAST_JAX_PATH[0] = "rlc-sharded-streamed"
+            return mask
+    else:
+        for attempt in range(2):
+            try:
+                if tr is not None:
+                    with tr.span("rlc.streamed", n=len(pubkeys)):
+                        mask = _verify_batch_rlc_streamed(pubkeys, msgs, sigs)
+                else:
+                    mask = _verify_batch_rlc_streamed(pubkeys, msgs, sigs)
+                break
+            except Exception as e:
+                if attempt == 0 and msm_jax.last_submit_fused():
+                    # same contract as _verify_batch_rlc: one bad Mosaic
+                    # compile costs one retry unfused, not the path
+                    msm_jax.disable_fused(repr(e))
+                    continue
+                import logging
+
+                logging.getLogger("tendermint_tpu.crypto.batch").exception(
+                    "streamed RLC failed; recovering chunk by chunk"
+                )
+                mask = None
+                break
+        if mask is not None:
+            LAST_JAX_PATH[0] = "rlc-streamed"
+            return mask
+    # exact recovery: the combined check only short-circuits when every row
+    # passes; chunk-local RLC + per-sig fallback recovers the identical mask
+    # a single-flush fallback would have produced, with bounded memory
+    detail = {
+        k: LAST_FLUSH_DETAIL.get(k)
+        for k in ("chunks", "chunk_lanes", "peak_lanes_in_flight")
+    }
+    parts = []
+    for lo, hi in _planner_chunks(len(pubkeys)):
+        parts.append(verify_batch_jax(pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi]))
+    LAST_FLUSH_DETAIL["rlc_fallback"] = True
+    for k, v in detail.items():
+        if v is not None:
+            LAST_FLUSH_DETAIL[k] = v
+    LAST_JAX_PATH[0] = "rlc-streamed-recovery"
+    return np.concatenate(parts)
+
+
 def _verify_batch_rlc(
     pubkeys: Sequence[bytes],
     msgs: Sequence[bytes],
@@ -1102,11 +1556,17 @@ def _sharded_env():
     from tendermint_tpu.parallel.sharded import (
         make_mesh,
         sharded_rlc_check,
+        sharded_rlc_stream,
         sharded_verify,
     )
 
     mesh = make_mesh(devs[:nd], axis_names=("vals",))
-    _SHARDED_RUNNER = (nd, sharded_verify(mesh), sharded_rlc_check(mesh))
+    _SHARDED_RUNNER = (
+        nd,
+        sharded_verify(mesh),
+        sharded_rlc_check(mesh),
+        sharded_rlc_stream(mesh),
+    )
     return _SHARDED_RUNNER
 
 
@@ -1129,7 +1589,7 @@ def _verify_batch_rlc_sharded(
     env = _sharded_env()
     if env is None:
         return None
-    nd, _, rlc_run = env
+    nd, _, rlc_run, _stream = env
     n = len(pubkeys)
     from tendermint_tpu import native
 
@@ -1215,6 +1675,11 @@ def verify_batch_jax(
 
     sharded = _sharded_runner()
     if _rlc_enabled() and len(pubkeys) >= RLC_MIN:
+        if planner_engaged(len(pubkeys)):
+            # over the device budget: stream fixed-bucket chunks through the
+            # flush planner (single-device or sharded; includes its own
+            # chunked exact-mask recovery, so it always returns a mask)
+            return _verify_batch_streamed(pubkeys, msgs, sigs)
         if sharded is not None:
             mask = _verify_batch_rlc_sharded(pubkeys, msgs, sigs)
             if mask is not None:
@@ -1469,6 +1934,9 @@ def verify_batch_submit(
         and BREAKER.allow_device()
         and _rlc_enabled()
         and len(pubkeys) >= max(RLC_MIN, _JAX_MIN_BATCH if backend is None else 0)
+        # over-budget row sets stream through the flush planner (which IS
+        # the submit/finish overlap, chunk-pipelined) via the eager path
+        and not planner_engaged(len(pubkeys))
         and _sharded_runner() is None
         and (not mixed or all(t in ("ed25519", "sr25519") for t in (key_types or [])))
         and len(pubkeys) > 0
@@ -1687,6 +2155,9 @@ def verify_batch(
         fused=detail.get("fused"),
         h2d_bytes=detail.get("h2d_bytes"),
         device_dispatches=detail.get("device_dispatches"),
+        chunks=detail.get("chunks"),
+        chunk_lanes=detail.get("chunk_lanes"),
+        prep_overlap_s=detail.get("prep_overlap_s"),
         tracer_=tr,
     )
     if span is not None:
@@ -1711,6 +2182,10 @@ def _verify_batch_routed(
             and BREAKER.allow_device()
             and _rlc_enabled()
             and len(pubkeys) >= RLC_MIN
+            # an over-budget MIXED set takes the exact per-type split below:
+            # its ed25519 rows re-enter verify_batch and stream through the
+            # planner, so no path ever compiles an over-budget shape
+            and not planner_engaged(len(pubkeys))
             and _sharded_runner() is None
             # the mixed kernel only knows these two types; any other row
             # must take the exact per-type path (which marks unknown types
@@ -1760,6 +2235,7 @@ def prewarm(
     n_vals: int,
     backend: str | None = None,
     pubkeys: Sequence[bytes] | None = None,
+    planner_chunk: bool = True,
 ) -> None:
     """Compile (or load from the persistent cache) the kernels a node with an
     n_vals validator set will hit: the plain RLC kernel (first sight of a
@@ -1767,7 +2243,11 @@ def prewarm(
     verify_batch_jax — the sharded variants on multi-device hosts. When the
     node's REAL validator pubkeys are provided, their decoded coordinates are
     also pre-filled into the A cache so the very first consensus flush takes
-    the steady-state path.
+    the steady-state path. With planner_chunk, the flush planner's chunk
+    bucket (the ONE shape every streamed super-batch runs: rlc_partial +
+    fold + identity, ops/msm_jax.py) is warmed in the same background
+    thread, so the first oversized catch-up flush doesn't eat a multi-minute
+    compile mid-sync.
 
     Called from node startup in a BACKGROUND thread (node/node.py) so a node
     cold-starting into a vote storm doesn't stall consensus for the first
@@ -1792,6 +2272,12 @@ def prewarm(
     verify_batch_jax(dummy, msgs, sigs)
     # 2nd call: cache hit -> CACHED-A kernel (the steady-state variant).
     verify_batch_jax(dummy, msgs, sigs)
+    if planner_chunk and _rlc_enabled():
+        # minimal 2-chunk streamed flush: warms the chunk-bucket partial
+        # kernel (both chunks pad to the same shape), the padd fold, and
+        # the identity check — the steady-state streamed shapes
+        rows = planner_chunk_rows() + 1
+        verify_batch_jax([pk] * rows, [msg] * rows, [sig] * rows)
     if pubkeys:
         # decode the real validator keys so consensus's first flush is a
         # cache hit (this is the exact decode steady state amortizes away)

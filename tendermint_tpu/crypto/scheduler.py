@@ -605,7 +605,7 @@ class VerifyScheduler:
         mask: Optional[np.ndarray] = None
         error: Optional[BaseException] = None
         try:
-            mask = _batch.verify_batch(pubkeys, msgs, sigs, self.backend, kt_arg)
+            mask = self._verify_chunked(pubkeys, msgs, sigs, kt_arg)
         except BaseException as e:  # tickets re-raise; the thread survives
             error = e
             logger.exception(
@@ -645,6 +645,49 @@ class VerifyScheduler:
             ticket.flush_seq = seq
             ticket.wait_s = t_flush - ticket.enqueued_t
             ticket._resolve(mask[start:end] if mask is not None else None, error)
+
+    def _verify_chunked(self, pubkeys, msgs, sigs, kt_arg) -> np.ndarray:
+        """The dispatch thread's verify body: an oversized combined flush
+        (catch-up super-batches, admission floods) splits into flush-planner
+        chunks (crypto/batch.planner_chunk_rows) with a PREEMPTION POINT
+        between chunks — vote rows that queued while a chunk ran flush next,
+        alone, before the following chunk. A vote flush therefore waits at
+        most ONE chunk, never a 200k-lane monolith; verdict slices stay
+        byte-identical (chunk masks concatenate in row order, and each chunk
+        rides the normal verify_batch ladder)."""
+        from tendermint_tpu.crypto import batch as _batch
+
+        chunk = _batch.planner_chunk_rows()
+        n = len(pubkeys)
+        if n <= chunk:
+            return _batch.verify_batch(pubkeys, msgs, sigs, self.backend, kt_arg)
+        parts = []
+        for lo in range(0, n, chunk):
+            if lo:
+                self._preempt_votes_between_chunks()
+            hi = min(lo + chunk, n)
+            parts.append(
+                _batch.verify_batch(
+                    pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi], self.backend,
+                    kt_arg[lo:hi] if kt_arg is not None else None,
+                )
+            )
+        return np.concatenate(parts)
+
+    def _preempt_votes_between_chunks(self) -> None:
+        """Between-chunk preemption point (dispatch thread only): drain any
+        queued vote rows into their own flush before the next bulk chunk."""
+        with self._cv:
+            st = self._lanes["votes"]
+            if not st.queue:
+                return
+            entries = list(st.queue)
+            st.queue.clear()
+            st.rows = 0
+            self.preemptions += 1
+            if self.metrics is not None:
+                self.metrics.preemptions.inc()
+        self._flush(entries, {"votes"})
 
     # -- introspection / lifecycle --------------------------------------------
 

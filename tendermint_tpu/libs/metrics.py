@@ -694,6 +694,18 @@ class BatchVerifyMetrics:
             f"{ns}_rlc_fallbacks_total",
             "RLC combined-check failures recovered via the per-signature path.",
         )
+        # streamed flush planner (crypto/batch.py ISSUE 13)
+        self.chunks_per_flush = reg.histogram(
+            f"{ns}_chunks_per_flush",
+            "Planner chunks per STREAMED flush (unstreamed flushes are not "
+            "observed here — count those via flushes_total by path).",
+            buckets=(1, 2, 3, 4, 6, 9, 17, 33, 65),
+        )
+        self.prep_overlap_seconds = reg.counter(
+            f"{ns}_prep_overlap_seconds_total",
+            "Host-prep seconds overlapped with device execution by the "
+            "streamed planner's double buffer.",
+        )
         self.compile_seconds = reg.counter(
             f"{ns}_compile_seconds_total",
             "Seconds spent tracing/exporting (export) or loading (deserialize) kernels.",

@@ -214,6 +214,9 @@ def record_flush(
     fused: Optional[bool] = None,
     h2d_bytes: Optional[int] = None,
     device_dispatches: Optional[int] = None,
+    chunks: Optional[int] = None,
+    chunk_lanes: Optional[int] = None,
+    prep_overlap_s: Optional[float] = None,
     tracer_: Optional[Tracer] = None,
 ) -> None:
     """One batch-verify flush completed. Called by crypto/batch.verify_batch
@@ -247,6 +250,12 @@ def record_flush(
         m.pubkey_cache_misses.inc(cache_misses)
     if rlc_fallback:
         m.rlc_fallbacks.inc()
+    # streamed flush planner (crypto/batch.py ISSUE 13): chunk count per
+    # flush + the host-prep wall the double buffer hid behind device work
+    if chunks is not None:
+        m.chunks_per_flush.observe(chunks)
+    if prep_overlap_s:
+        m.prep_overlap_seconds.inc(prep_overlap_s)
 
     last = {
         "backend": backend,
@@ -279,6 +288,12 @@ def record_flush(
         last["h2d_bytes"] = h2d_bytes
     if device_dispatches is not None:
         last["device_dispatches"] = device_dispatches
+    if chunks is not None:
+        last["chunks"] = chunks
+    if chunk_lanes is not None:
+        last["chunk_lanes"] = chunk_lanes
+    if prep_overlap_s is not None:
+        last["prep_overlap_ms"] = round(prep_overlap_s * 1e3, 4)
     with _STATS_LOCK:
         t = _TOTALS.setdefault(
             (backend, path), {"flushes": 0, "sigs": 0, "seconds": 0.0}

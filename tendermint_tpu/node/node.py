@@ -90,6 +90,10 @@ class Node:
             probe_interval_base=config.crypto.breaker_probe_base,
             probe_interval_max=config.crypto.breaker_probe_max,
         )
+        # streamed flush planner budget (same process-global model)
+        _batch.configure_planner(
+            max_flush_lanes=getattr(config.crypto, "max_flush_lanes", None)
+        )
         self._owns_priv_validator = False
         if priv_validator is None and config.base.priv_validator_addr:
             # dial the remote signer (reference: node/node.go:658

@@ -833,6 +833,46 @@ def _rlc_core(
     return jnp.concatenate([bok[None], ok])
 
 
+def _rlc_partial_core(
+    pts_bytes: jnp.ndarray,  # (32, N) uint8 — chunk lanes [A | B | R | pads]
+    perm: jnp.ndarray,  # (T, N)
+    ends: jnp.ndarray,  # (T, NBUCKETS) int32
+    fctx: FieldCtx,  # at shape (N,)
+    C: SmallCtx,
+    fused: bool = False,
+):
+    """One streamed-planner chunk (crypto/batch.py): the full Pippenger
+    pipeline over this chunk's lanes, WITHOUT the identity check — the MSM
+    is a sum over lanes, so an arbitrarily large flush decomposes into
+    fixed-bucket partial sums accumulated on device (_partial_fold_core)
+    with one identity check at the end (_partial_identity_core).
+
+    Returns (coords (4, 20) int32 — the chunk's partial point in extended
+    limbs, ok (N,) bool — per-lane decompress validity)."""
+    p, ok = decompress(fctx, pts_bytes)
+    p = _pselect(ok, p, identity(fctx))
+    if fused:
+        part = _msm_total_fused(C, p, perm, ends)
+    else:
+        node_idx = fenwick_nodes_device(ends, pts_bytes.shape[-1])
+        part = _msm_total(C, p, perm, node_idx)
+    return jnp.stack(part), ok
+
+
+def _partial_fold_core(a: jnp.ndarray, b: jnp.ndarray, C: SmallCtx) -> jnp.ndarray:
+    """Fold two (4, 20) partial points: ONE unified add — the tiny combine
+    kernel the streamed planner dispatches per chunk (device-resident
+    accumulation; nothing but the two points ever lives in HBM)."""
+    s = _padd(C, Point(a[0], a[1], a[2], a[3]), Point(b[0], b[1], b[2], b[3]))
+    return jnp.stack(s)
+
+
+def _partial_identity_core(a: jnp.ndarray, C: SmallCtx) -> jnp.ndarray:
+    """Identity check on an accumulated (4, 20) partial point — the streamed
+    flush's combined-check verdict."""
+    return point_is_identity(C, Point(a[0], a[1], a[2], a[3]))
+
+
 def _rlc_core_cached(
     ax, ay, az, at,  # (20, Na) predecompressed A block (incl. B lane)
     r_bytes,  # (32, Nr) uint8
@@ -935,6 +975,10 @@ _rlc_cached_mixed_jit = jax.jit(_rlc_core_cached_mixed)
 _rlc_cached_mixed_jit_fused = jax.jit(
     functools.partial(_rlc_core_cached_mixed, fused=True)
 )
+_rlc_partial_jit = jax.jit(_rlc_partial_core)
+_rlc_partial_jit_fused = jax.jit(functools.partial(_rlc_partial_core, fused=True))
+_partial_fold_jit = jax.jit(_partial_fold_core)
+_partial_identity_jit = jax.jit(_partial_identity_core)
 
 
 def _device_sort_enabled() -> bool:
@@ -1010,6 +1054,48 @@ def rlc_check_submit(
 def rlc_check(pts_bytes: np.ndarray, scalars: Sequence[int]) -> Tuple[bool, np.ndarray]:
     out = np.asarray(rlc_check_submit(pts_bytes, scalars))
     return bool(out[0]), out[1:]
+
+
+def rlc_partial_submit(
+    pts_bytes: np.ndarray, scalars, zero16_from: int = 0, presorted=None
+):
+    """Host prep + async submit of ONE streamed-flush chunk's partial MSM
+    (crypto/batch.py's flush planner): same prep as rlc_check_submit, but
+    the kernel returns the chunk's partial point instead of a verdict.
+    `presorted=(perm, ends)` skips the window sort here — the planner's
+    prep WORKER sorts chunk k+1 while chunk k's kernels execute, so the
+    sort must not re-run on the submitting thread.
+    Returns (coords (4, 20) int32 device array, ok (N,) bool device array)
+    — both unsynced; np.asarray() to sync."""
+    n = pts_bytes.shape[0]
+    with _trace_span("kernel.rlc_partial_submit", variant="partial", lanes=n):
+        if presorted is not None:
+            perm, ends = presorted
+        else:
+            digits = scalars_to_bytes(scalars, n)
+            perm, ends = sort_windows(digits, zero16_from=zero16_from)
+        fctx = make_ctx((n,))
+        fused = fused_for_lanes(n)
+        _set_submit_fused(fused)
+        return _dispatch(
+            "rlc_partial_f" if fused else "rlc_partial",
+            _rlc_partial_jit_fused if fused else _rlc_partial_jit,
+            np.ascontiguousarray(pts_bytes.T), perm, ends, fctx, make_small_ctx(),
+        )
+
+
+def partial_fold_submit(acc, part):
+    """Device-resident accumulation of streamed-chunk partials: one tiny
+    padd kernel over two (4, 20) points (async; a no-sync dispatch)."""
+    return _dispatch("partial_fold", _partial_fold_jit, acc, part, make_small_ctx())
+
+
+def partial_identity_submit(acc):
+    """The streamed flush's combined-check verdict on the accumulated
+    partial point. Returns an unsynced device bool scalar."""
+    return _dispatch(
+        "partial_ident", _partial_identity_jit, acc, make_small_ctx()
+    )
 
 
 def rlc_check_cached_submit(

@@ -36,6 +36,13 @@ from typing import Any, Dict, List, Optional
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
+# The trajectory's north-star datapoint. bench.py now prepends this config
+# to every scenario-scoped plan, and the ledger flags any round that still
+# lacks it (BENCH_r06 was a catchup-only round that silently lost the
+# headline — the matrix showed it, the headline row did not).
+HEADLINE_SCENARIO = "verify_commit_10k"
+HEADLINE_METRIC = f"{HEADLINE_SCENARIO}_latency"
+
 
 def _round_of(path: str) -> Optional[int]:
     m = _ROUND_RE.search(os.path.basename(path))
@@ -130,6 +137,14 @@ def parse_bench(path: str) -> dict:
             if host.get(k)
         }
     row["scenarios"] = _scenario_speedups(extra)
+    # a parsed round that carries NEITHER the headline metric nor a
+    # headline scenario datapoint lost the trajectory point — flag it
+    # explicitly instead of leaving a silent gap in the matrix
+    row["headline_missing"] = (
+        row["metric"] != HEADLINE_METRIC
+        and HEADLINE_SCENARIO not in row["scenarios"]
+        and HEADLINE_SCENARIO not in (extra or {})
+    )
     if not isinstance(row["value"], (int, float)) or row["value"] < 0:
         row["lost"] = True
         err = extra.get("error") or parsed.get("degrade_reason")
@@ -198,6 +213,9 @@ def load_ledger(root: str) -> dict:
         "lost_datapoints": [
             r["file"] for r in bench + multichip if r.get("lost")
         ],
+        "headline_missing_rounds": [
+            r["file"] for r in bench if r.get("headline_missing")
+        ],
     }
 
 
@@ -256,12 +274,16 @@ def render_markdown(ledger: dict) -> str:
     for r in ledger["bench"]:
         if r["lost"]:
             status = f"**LOST** — {r['lost_reason']}"
+            if r.get("headline_missing"):
+                status += "; headline MISSING"
             value = "—"
             speed = "—"
         else:
             status = "degraded (cpu-fallback)" if r.get("degraded") else "ok"
             if r.get("lost_reason"):
                 status += f"; {r['lost_reason']}"
+            if r.get("headline_missing"):
+                status += "; **headline MISSING**"
             value = (
                 f"{r['value']:.1f} {r['unit'] or ''}".strip()
                 if isinstance(r["value"], (int, float))
