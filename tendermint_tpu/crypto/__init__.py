@@ -1,8 +1,12 @@
 from tendermint_tpu.crypto.keys import (  # noqa: F401
     PrivKey,
     PubKey,
+    Bls12381PrivKey,
+    Bls12381PubKey,
     Ed25519PrivKey,
     Ed25519PubKey,
     address_from_pubkey_bytes,
+    gen_bls12_381,
     gen_ed25519,
+    register_pop,
 )

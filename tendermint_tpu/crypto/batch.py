@@ -115,6 +115,20 @@ def configure_breaker(**kwargs) -> None:
     """Apply `[crypto]` breaker config (node/node.py)."""
     BREAKER.configure(**kwargs)
 
+
+def record_backend_rows(backend: str, rows: int) -> None:
+    """One (rows, flush) observation on the per-signature-scheme series
+    (tendermint_batch_verify_backend_*): every routing site that settles
+    rows of a scheme calls this exactly once for them, so BLS/sr25519
+    volume never folds into the ed25519 headline.
+    types/validator_set.verify_aggregate_commit records the aggregate path
+    (each covered signer counts as one row)."""
+    from tendermint_tpu.libs import metrics as _metrics
+
+    m = _metrics.batch_metrics()
+    m.backend_rows.labels(backend).inc(rows)
+    m.backend_flushes.labels(backend).inc()
+
 _BUCKET_SIZES = [2**i for i in range(17)]  # jit shape buckets: 1..65536
 
 
@@ -1714,13 +1728,28 @@ def _verify_batch_mixed_exact(
     pubkeys, msgs, sigs, key_types, backend=None
 ) -> np.ndarray:
     """Exact per-type routing for mixed sets: ed25519 rows through the
-    selected backend, sr25519 rows through the host schnorrkel path, any
-    unknown type False."""
+    selected backend, sr25519 rows through the host schnorrkel path,
+    bls12_381 rows through the bls_ref host verifier (per-signature; the
+    aggregate fast path lives in types/validator_set.verify_aggregate_commit
+    — a commit that ARRIVES unaggregated pays per-sig pairing cost here),
+    any unknown type False."""
     from tendermint_tpu.crypto.sr25519 import sr25519_verify
 
     out = np.zeros(len(pubkeys), dtype=bool)
     ed_idx = [i for i, t in enumerate(key_types) if t == "ed25519"]
     sr_idx = [i for i, t in enumerate(key_types) if t == "sr25519"]
+    bls_idx = [i for i, t in enumerate(key_types) if t == "bls12_381"]
+    if sr_idx:
+        record_backend_rows("sr25519", len(sr_idx))
+    if bls_idx:
+        record_backend_rows("bls12_381", len(bls_idx))
+        from tendermint_tpu.crypto import bls_ref
+
+        for i in bls_idx:
+            sig = bytes(sigs[i])
+            out[i] = len(sig) == bls_ref.SIGNATURE_SIZE and bls_ref.verify(
+                bytes(pubkeys[i]), bytes(msgs[i]), sig
+            )
     if ed_idx:
         sub = verify_batch(
             [pubkeys[i] for i in ed_idx],
@@ -2008,6 +2037,17 @@ def verify_batch_finish(h: BatchHandle) -> np.ndarray:
         )
         mask = None
     detail = dict(LAST_FLUSH_DETAIL)
+    if not mixed:
+        record_backend_rows("ed25519", len(pubkeys))
+    elif mask is not None:
+        # successful mixed RLC finish: attribute here — the FAILED mixed
+        # path recurses through _verify_batch_mixed_exact below, which
+        # records its own per-scheme rows (submit eligibility limits the
+        # mixed RLC branch to these two types)
+        for kt in ("ed25519", "sr25519"):
+            kn = sum(1 for t in key_types if t == kt)
+            if kn:
+                record_backend_rows(kt, kn)
     if mask is not None:
         h._mask = mask
         BREAKER.record_success(time.perf_counter() - t_fin)
@@ -2196,6 +2236,10 @@ def _verify_batch_routed(
             mask = _verify_batch_rlc(pubkeys, msgs, sigs, key_types)
             if mask is not None:
                 LAST_JAX_PATH[0] = "rlc-mixed"
+                for kt in ("ed25519", "sr25519"):
+                    kn = sum(1 for t in key_types if t == kt)
+                    if kn:
+                        record_backend_rows(kt, kn)
                 return mask, be, "rlc-mixed"
             rlc_fell_back = True
         else:
@@ -2207,6 +2251,7 @@ def _verify_batch_routed(
             LAST_FLUSH_DETAIL["rlc_fallback"] = True
         return mask, be, "mixed"
     be = backend or backend_default()
+    record_backend_rows("ed25519", len(pubkeys))
     # Auto-selected jax falls back to the host loop for tiny batches: a
     # handful of signatures is faster on CPU than one device round-trip
     # (100-200ms through a TPU tunnel), and a 1-2 validator chain should
@@ -2231,11 +2276,29 @@ def _verify_batch_routed(
     raise ValueError(f"unknown crypto backend {be!r}")
 
 
+def _prewarm_bls() -> None:
+    """Warm the BLS aggregate path in the prewarm thread: module-level
+    constant derivation (bls_ref's Frobenius/psi tables), one hash-to-G2
+    + pairing, and the MSM bitmap-fold bucket (ops/bls12_msm) — so a
+    node's FIRST aggregate-commit verify doesn't pay the import/derive
+    cost inside the consensus receive loop. Throwaway key material only."""
+    from tendermint_tpu.crypto import bls_ref
+    from tendermint_tpu.ops import bls12_msm
+
+    sk = bls_ref.gen_sk()
+    pk = bls_ref.sk_to_pk(sk)
+    sig = bls_ref.sign(sk, b"prewarm")
+    aff = bls_ref._jac_to_affine(bls_ref.g1_from_bytes(pk))
+    bls12_msm.g1_aggregate_bitmap([(aff[0].v, aff[1].v)] * 4, [True] * 4)
+    bls_ref.verify(pk, b"prewarm", sig)
+
+
 def prewarm(
     n_vals: int,
     backend: str | None = None,
     pubkeys: Sequence[bytes] | None = None,
     planner_chunk: bool = True,
+    bls: bool = False,
 ) -> None:
     """Compile (or load from the persistent cache) the kernels a node with an
     n_vals validator set will hit: the plain RLC kernel (first sight of a
@@ -2255,6 +2318,8 @@ def prewarm(
     flush that arrives mid-prewarm blocks until the compile finishes instead
     of compiling again. The throwaway signing key is random (os.urandom), so
     nothing derivable ever enters the cache."""
+    if bls:
+        _prewarm_bls()
     be = backend or backend_default()
     if be != "jax" or n_vals < _JAX_MIN_BATCH:
         return  # small valsets ride the host loop; nothing to compile

@@ -35,10 +35,13 @@ from tendermint_tpu.crypto import tmhash
 
 ED25519_KEY_TYPE = "ed25519"
 SR25519_KEY_TYPE = "sr25519"
+BLS12_381_KEY_TYPE = "bls12_381"
 
 PUBKEY_SIZE = 32
 PRIVKEY_SIZE = 32  # seed
 SIGNATURE_SIZE = 64
+BLS_PUBKEY_SIZE = 48  # compressed G1
+BLS_SIGNATURE_SIZE = 96  # compressed G2
 ADDRESS_SIZE = tmhash.TRUNCATED_SIZE
 
 
@@ -261,6 +264,122 @@ def gen_ed25519(seed: bytes | None = None) -> Ed25519PrivKey:
     return Ed25519PrivKey(seed if seed is not None else os.urandom(PRIVKEY_SIZE))
 
 
+# ---------------------------------------------------------------------------
+# BLS12-381 (aggregate-signature backend; crypto/bls_ref.py + ops/bls12_msm)
+
+
+@dataclass(frozen=True)
+class Bls12381PubKey(PubKey):
+    """48-byte compressed G1 public key (minimal-pubkey-size ciphersuite).
+
+    Subgroup membership is enforced at construction via the validator-
+    ingestion gate (pubkey_from_type_and_bytes) — a non-subgroup key could
+    make the aggregate pairing check and the per-signature fallback
+    disagree, the exact per-node divergence the ed25519 canonicality gate
+    exists to close."""
+
+    key_bytes: bytes
+
+    def __post_init__(self):
+        if len(self.key_bytes) != BLS_PUBKEY_SIZE:
+            raise ValueError(f"bls12_381 pubkey must be {BLS_PUBKEY_SIZE} bytes")
+
+    def address(self) -> bytes:
+        return address_from_pubkey_bytes(self.key_bytes)
+
+    def bytes(self) -> bytes:
+        return self.key_bytes
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != BLS_SIGNATURE_SIZE:
+            return False
+        from tendermint_tpu.crypto import bls_ref
+
+        return bls_ref.verify(self.key_bytes, msg, sig)
+
+    def type_name(self) -> str:
+        return BLS12_381_KEY_TYPE
+
+    def __hash__(self) -> int:
+        return hash((BLS12_381_KEY_TYPE, self.key_bytes))
+
+
+@dataclass(frozen=True, repr=False)
+class Bls12381PrivKey(PrivKey):
+    seed: bytes  # >= 32-byte IKM for the spec KeyGen
+
+    def __repr__(self) -> str:  # never print private key material
+        return "Bls12381PrivKey(<redacted>)"
+
+    def __post_init__(self):
+        if len(self.seed) < 32:
+            raise ValueError("bls12_381 privkey seed must be >= 32 bytes")
+
+    @property
+    def _sk(self) -> int:
+        from tendermint_tpu.crypto import bls_ref
+
+        return bls_ref.keygen(self.seed)
+
+    def bytes(self) -> bytes:
+        return self.seed
+
+    def sign(self, msg: bytes) -> bytes:
+        from tendermint_tpu.crypto import bls_ref
+
+        return bls_ref.sign(self._sk, msg)
+
+    def pub_key(self) -> Bls12381PubKey:
+        from tendermint_tpu.crypto import bls_ref
+
+        return Bls12381PubKey(bls_ref.sk_to_pk(self._sk))
+
+    def pop_prove(self) -> bytes:
+        """Proof of possession for rogue-key-safe aggregation."""
+        from tendermint_tpu.crypto import bls_ref
+
+        return bls_ref.pop_prove(self._sk)
+
+    def type_name(self) -> str:
+        return BLS12_381_KEY_TYPE
+
+
+def gen_bls12_381(seed: bytes | None = None) -> Bls12381PrivKey:
+    return Bls12381PrivKey(seed if seed is not None else os.urandom(32))
+
+
+# Proof-of-possession registry: the rogue-key defense for aggregation.
+# VerifyAggregateCommit refuses to fold any BLS key into an aggregate
+# pairing check unless its PoP has been verified here (registration
+# happens at validator ingestion: genesis doc / ABCI validator updates
+# carry the proof next to the key). Per-signature verification does NOT
+# require PoP — only aggregation is rogue-key-attackable. Process-global
+# like the batch pipeline's pubkey cache.
+_POP_VERIFIED: set = set()
+
+
+def register_pop(pubkey_bytes: bytes, proof: bytes) -> bool:
+    """Verify + record a proof of possession; False (not raised) on a bad
+    proof so ingestion sites can reject the validator instead of dying."""
+    from tendermint_tpu.crypto import bls_ref
+
+    if bytes(pubkey_bytes) in _POP_VERIFIED:
+        return True
+    if not bls_ref.pop_verify(bytes(pubkey_bytes), bytes(proof)):
+        return False
+    _POP_VERIFIED.add(bytes(pubkey_bytes))
+    return True
+
+
+def pop_verified(pubkey_bytes: bytes) -> bool:
+    return bytes(pubkey_bytes) in _POP_VERIFIED
+
+
+def clear_pop_registry() -> None:
+    """Test hook."""
+    _POP_VERIFIED.clear()
+
+
 def pubkey_from_type_and_bytes(type_name: str, data: bytes) -> PubKey:
     """Validator-ingestion entry point (genesis + ABCI validator updates).
 
@@ -280,4 +399,14 @@ def pubkey_from_type_and_bytes(type_name: str, data: bytes) -> PubKey:
         except ImportError as e:  # pragma: no cover
             raise ValueError(f"sr25519 backend unavailable: {e}") from e
         return Sr25519PubKey(data)
+    if type_name == BLS12_381_KEY_TYPE:
+        from tendermint_tpu.crypto import bls_ref
+
+        # Full decode: valid compressed encoding, on curve, IN SUBGROUP,
+        # not the identity — anything less lets per-node verification
+        # semantics diverge (see Bls12381PubKey docstring).
+        pt = bls_ref.g1_from_bytes(data)
+        if pt is None or bls_ref._jac_is_identity(pt):
+            raise ValueError("invalid bls12_381 pubkey (encoding/subgroup)")
+        return Bls12381PubKey(data)
     raise ValueError(f"unknown pubkey type {type_name!r}")

@@ -694,6 +694,26 @@ class BatchVerifyMetrics:
             f"{ns}_rlc_fallbacks_total",
             "RLC combined-check failures recovered via the per-signature path.",
         )
+        # signature-scheme attribution (ISSUE 14): BLS rows must never fold
+        # into the ed25519 RLC headline — perf_ledger grows the matching
+        # backend column from bench results, this is the live-node series
+        self.backend_rows = reg.counter(
+            f"{ns}_backend_rows_total",
+            "Verification rows by signature backend (ed25519/sr25519/"
+            "bls12_381; an aggregate-commit verify counts each covered "
+            "signer as one row).",
+            ("backend",),
+        )
+        self.backend_flushes = reg.counter(
+            f"{ns}_backend_flushes_total",
+            "Flushes/verifies that carried rows of each signature backend.",
+            ("backend",),
+        )
+        self.aggregate_size = reg.gauge(
+            f"{ns}_aggregate_size",
+            "Validators covered by the last BLS aggregate-commit "
+            "verification (one 96-byte signature regardless of this value).",
+        )
         # streamed flush planner (crypto/batch.py ISSUE 13)
         self.chunks_per_flush = reg.histogram(
             f"{ns}_chunks_per_flush",

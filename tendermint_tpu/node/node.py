@@ -617,14 +617,19 @@ class Node:
             vals = self.consensus.rs.validators
             n_vals = vals.size()
             pubkeys = [v.pub_key.bytes() for v in vals.validators]
+            # BLS buckets warm only when the valset actually carries BLS
+            # keys (flag-gated; zero cost on pure-ed25519 chains)
+            has_bls = any(
+                v.pub_key.type_name() == "bls12_381" for v in vals.validators
+            )
         except Exception:
-            n_vals, pubkeys = 0, None
-        if n_vals <= 0 or _batch.backend_default() != "jax":
+            n_vals, pubkeys, has_bls = 0, None, False
+        if n_vals <= 0 or (_batch.backend_default() != "jax" and not has_bls):
             return
 
         def run():
             try:
-                _batch.prewarm(n_vals, pubkeys=pubkeys)
+                _batch.prewarm(n_vals, pubkeys=pubkeys, bls=has_bls)
             except Exception:  # prewarm is best-effort; first caller compiles
                 import logging
 

@@ -1,0 +1,333 @@
+"""Batched GF(p) arithmetic for BLS12-381 in int32 limbs (numpy OR jax).
+
+Extends the fe25519 design (uniform small radix, leading limb axis, int32
+discipline) to the 381-bit base field. Two things change because p_381 has
+no Solinas structure (no cheap 2^k wrap like 2^260 ≡ 608 mod p_25519):
+
+- RADIX drops 13 -> 12 and NLIMBS goes 20 -> 33 (396 bits of capacity):
+  the Montgomery interleave below ADDS up to 33 more radix-width products
+  per limb on top of the convolution's 33, and 2 * 33 * (2^12)^2 < 2^31
+  is what keeps every accumulator a non-negative int32 (the fe25519 radix
+  would overflow: 2 * 30 * (2^13)^2 > 2^31).
+- Reduction is MONTGOMERY (R = 2^396), interleaved limb-serial like a CIOS
+  pass but vectorized across the batch axis: after the 65-limb school book
+  convolution, 33 steps each zero one low limb (m_i = T_i * (-p^-1) mod
+  2^12; T += m_i * p << 12i; push T_i's carry up) and the top 33 limbs are
+  the Montgomery product. Elements therefore live in the Montgomery domain
+  (value * R mod p) on device; host boundaries convert with python ints.
+
+The PACKED transfer/storage layout is 13 int32 words of radix 30 (390 bits
+>= the canonical 381) — the pallas_msm packed layout extended from 10
+words x radix 26 (ed25519) to 13 words for the wider field; pack/unpack are
+host-side numpy.
+
+Every op is written over PYTHON LISTS of per-limb rows (the pallas_fe
+in-kernel idiom), so the SAME code runs on numpy arrays (the tier-1 CPU
+twin, zero XLA work) and on jax arrays (the device path) — the two are
+bit-for-bit identical by construction, and tests/test_bls_kernels.py pins
+the numpy twin against crypto/bls_ref.py's python-int arithmetic.
+
+Value-bound discipline (each op documents its part):
+- "carried" limbs are <= 2^12 (one unit of slack above 2^12 - 1 is fine
+  everywhere: the convolution bound uses 2 * 33 * 4096^2 = 1.108e9 < 2^31);
+- mul/square require input VALUES < 2^388 (so a*b < R*p) and return < 2p;
+- add returns the plain sum; sub adds the all-4096 complement (value
+  ~2^384 ~ 13p) — so value magnitude grows by ~13p per sub and resets
+  < 2p at the next mul. The longest mul-free add/sub chain in the point
+  formulas (ops/bls12_msm.py) is 4 ops: worst case < 2p + 4*14p < 2^387.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R_ORDER = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001  # G1/G2 order r
+
+RADIX = 12
+NLIMBS = 33
+MASK = (1 << RADIX) - 1
+NBITS = RADIX * NLIMBS  # 396
+R_MONT = (1 << NBITS) % P
+R_INV = pow(1 << NBITS, P - 2, P)
+PPRIME = (-pow(P, -1, 1 << RADIX)) % (1 << RADIX)  # -p^-1 mod 2^12
+
+PACK_RADIX = 30
+PACK_WORDS = 13  # 13 * 30 = 390 bits >= 381
+
+
+def _limbs_of(x: int) -> List[int]:
+    return [(x >> (RADIX * i)) & MASK for i in range(NLIMBS)]
+
+
+P_LIMBS = _limbs_of(P)
+
+# sub complement: limbs 0..31 hold 2^13 (dominating any carried limb and
+# its <= 2 units of slack above MASK), the TOP limb holds only 8 — an
+# all-2^13 complement would have value ~2^397, past the 33-limb capacity.
+# The graded complement's value is ~2^388.2; sub and mul_small outputs
+# are immediately re-folded at bit 384 (W384 = 2^384 mod p, below), which
+# caps folded values at < 2^384 + top*p < 2^387.7 and their top limb at
+# <= 13 < 16 — so every op output is a valid operand everywhere: COMP
+# dominates the subtrahend limb-wise, and the Montgomery precondition
+# a*b < R*p = 2^776.9 holds for the worst product of two unfolded sums
+# (< 2^388.4 each; audited in ops/bls12_msm.py / ops/pallas_bls.py).
+COMP_LIMBS = [1 << (RADIX + 1)] * (NLIMBS - 1) + [16]
+_COMP_VAL = sum(c << (RADIX * i) for i, c in enumerate(COMP_LIMBS))
+CORR_LIMBS = _limbs_of(-_COMP_VAL % P)
+W384_LIMBS = _limbs_of((1 << (RADIX * (NLIMBS - 1))) % P)  # 2^384 mod p
+
+
+# --------------------------------------------------------------------------
+# host-side int conversions (python ints <-> limb vectors, Montgomery domain)
+
+
+def from_int(x: int) -> np.ndarray:
+    """python int -> canonical (NON-Montgomery) limbs, shape (33,)."""
+    return np.array(_limbs_of(x % P), dtype=np.int32)
+
+
+def to_int(limbs) -> int:
+    """limbs (33, ...) -> python int of lane 0 (limbs need not be canonical)."""
+    arr = np.asarray(limbs, dtype=np.int64).reshape(NLIMBS, -1)[:, 0]
+    return sum(int(arr[i]) << (RADIX * i) for i in range(NLIMBS)) % P
+
+
+def mont_from_int(x: int) -> np.ndarray:
+    """python int -> MONTGOMERY-domain limbs (x * R mod p)."""
+    return from_int(x % P * R_MONT % P)
+
+
+def mont_to_int(limbs) -> int:
+    """Montgomery limbs -> python int (value * R^-1 mod p)."""
+    return to_int(limbs) * R_INV % P
+
+
+def mont_from_ints(xs: Sequence[int]) -> np.ndarray:
+    """ints -> (33, n) int32 Montgomery limb block."""
+    out = np.zeros((NLIMBS, len(xs)), dtype=np.int32)
+    for j, x in enumerate(xs):
+        out[:, j] = mont_from_int(x)
+    return out
+
+
+def mont_to_ints(limbs) -> List[int]:
+    arr = np.asarray(limbs, dtype=np.int64).reshape(NLIMBS, -1)
+    out = []
+    for j in range(arr.shape[1]):
+        v = sum(int(arr[i, j]) << (RADIX * i) for i in range(NLIMBS)) % P
+        out.append(v * R_INV % P)
+    return out
+
+
+# --------------------------------------------------------------------------
+# packed transfer layout: 13 int32 words of radix 30 (canonical values only)
+
+
+def pack(values: Sequence[int]) -> np.ndarray:
+    """canonical ints -> (13, n) int32 packed words (radix 2^30)."""
+    out = np.zeros((PACK_WORDS, len(values)), dtype=np.int32)
+    m = (1 << PACK_RADIX) - 1
+    for j, v in enumerate(values):
+        if not 0 <= v < P:
+            raise ValueError("pack expects canonical field elements")
+        for i in range(PACK_WORDS):
+            out[i, j] = (v >> (PACK_RADIX * i)) & m
+    return out
+
+
+def unpack(words) -> List[int]:
+    arr = np.asarray(words, dtype=np.int64).reshape(PACK_WORDS, -1)
+    return [
+        sum(int(arr[i, j]) << (PACK_RADIX * i) for i in range(PACK_WORDS))
+        for j in range(arr.shape[1])
+    ]
+
+
+# --------------------------------------------------------------------------
+# core ops over row lists (np or jnp arrays; xp picked off the rows)
+
+Rows = List  # NLIMBS rows, each an array of identical batch shape
+
+
+def rows_of(a) -> Rows:
+    """(33, ...batch) array -> row list."""
+    return [a[i] for i in range(NLIMBS)]
+
+
+def stack(rows: Rows, xp=np):
+    return xp.stack(rows)
+
+
+def carry_rows(rows: Rows, passes: int = 2) -> Rows:
+    """Parallel carry passes, NO top wrap: NBITS = 396 gives 15 bits of
+    headroom above the < 2^388 value bound, so carry out of limb 32 is
+    impossible for in-discipline values. Two passes bring any <= 1.11e9
+    accumulation to limbs <= 2^12 + 1; a third (mul's output) to 2^12."""
+    for _ in range(passes):
+        out = []
+        carry_in = None
+        for r in rows:
+            c = r >> RADIX
+            masked = r & MASK
+            out.append(masked if carry_in is None else masked + carry_in)
+            carry_in = c
+        # carry out of the top limb would mean value >= 2^396: out of
+        # discipline by > 2^8; drop is deliberate (documented invariant).
+        rows = out
+    return rows
+
+
+def add_rows(a: Rows, b: Rows) -> Rows:
+    return carry_rows([x + y for x, y in zip(a, b)], passes=1)
+
+
+def fold_top_rows(a: Rows) -> Rows:
+    """Fold the top limb (bits 384..395) through W384 = 2^384 mod p:
+    resets the value bound to < 2^384 + a_top * p and the top limb to
+    <= 3 for any in-discipline input (a_top <= 12). One broadcast
+    multiply-add + carries — cheap enough to run after every sub."""
+    hi = a[NLIMBS - 1]
+    out = [x + hi * w for x, w in zip(a, W384_LIMBS)]
+    out[NLIMBS - 1] = hi * W384_LIMBS[NLIMBS - 1]
+    return carry_rows(out, passes=2)
+
+
+def sub_rows(a: Rows, b: Rows) -> Rows:
+    """a - b mod p via the graded complement + top fold (see COMP_LIMBS)."""
+    return fold_top_rows(
+        carry_rows(
+            [x + (k - y) + c for x, y, k, c in zip(a, b, COMP_LIMBS, CORR_LIMBS)],
+            passes=2,
+        )
+    )
+
+
+def mul_small_rows(a: Rows, k: int) -> Rows:
+    """a * k for small k (carried limbs * k < 2^31 => k < 2^19 - safe for
+    the b3 = 12 and 2/3/4/8 constants the point formulas use). The top
+    fold keeps the scaled value a valid operand for every downstream op."""
+    return fold_top_rows(carry_rows([x * k for x in a], passes=2))
+
+
+_P_COL = np.array(P_LIMBS, dtype=np.int32)[:, None]
+
+
+def _mul_np(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """Vectorized numpy form of mul_rows on stacked (33, ...batch) arrays:
+    33 shifted row multiply-adds for the convolution instead of 33*33
+    scalar-loop ops, identical int32 partial sums (addition order is free
+    within the proven < 2^31 bounds), so outputs are bit-for-bit equal to
+    the row-list form the jax path traces."""
+    batch = A.shape[1:]
+    a = A.reshape(NLIMBS, -1)
+    b = B.reshape(NLIMBS, -1)
+    prod = np.zeros((2 * NLIMBS, a.shape[1]), dtype=np.int32)
+    for i in range(NLIMBS):
+        prod[i : i + NLIMBS] += a[i][None, :] * b
+    for i in range(NLIMBS):
+        m = (prod[i] & MASK) * PPRIME & MASK
+        prod[i : i + NLIMBS] += m[None, :] * _P_COL
+        prod[i + 1] += prod[i] >> RADIX
+    out = prod[NLIMBS : 2 * NLIMBS]
+    for _ in range(3):
+        c = out >> RADIX
+        out = out & MASK
+        out[1:] += c[:-1]
+    return out.reshape(NLIMBS, *batch)
+
+
+def mul_rows(a: Rows, b: Rows) -> Rows:
+    """Montgomery product aR * bR -> abR (inputs carried, values < 2^388;
+    output carried, value < 2p).
+
+    Bounds: conv limb <= 33 * 4096^2 = 5.54e8; each Montgomery step adds
+    m_i * p_j <= 4095^2 per limb (33 steps but each limb index receives
+    from at most 33 of them) and the pushed carry <= 2.71e5 — every
+    accumulator < 1.11e9 < 2^31."""
+    if isinstance(a[0], np.ndarray):
+        return rows_of(_mul_np(np.stack(a), np.stack(b)))
+    return _mul_rows_loop(a, b)
+
+
+def _mul_rows_loop(a: Rows, b: Rows) -> Rows:
+    """Row-list form (what the jax path traces; XLA fuses the shifted
+    accumulations). tests pin it bit-for-bit against _mul_np."""
+    # 65-limb schoolbook convolution (plus one slot for the final carry)
+    n = NLIMBS
+    prod = [None] * (2 * n)
+    for i in range(n):
+        ai = a[i]
+        for j in range(n):
+            t = ai * b[j]
+            k = i + j
+            prod[k] = t if prod[k] is None else prod[k] + t
+    zero = a[0] - a[0]
+    prod[2 * n - 1] = zero
+    # interleaved Montgomery: zero limbs 0..32 one at a time
+    for i in range(n):
+        m = (prod[i] & MASK) * PPRIME & MASK
+        for j in range(n):
+            prod[i + j] = prod[i + j] + m * P_LIMBS[j]
+        prod[i + 1] = prod[i + 1] + (prod[i] >> RADIX)
+    out = prod[n : 2 * n]
+    return carry_rows(out, passes=3)
+
+
+def square_rows(a: Rows) -> Rows:
+    """Symmetric convolution (half the MACs), then the same Montgomery
+    interleave. Term-for-term equal partial sums to mul_rows(a, a)."""
+    if isinstance(a[0], np.ndarray):
+        return mul_rows(a, a)
+    n = NLIMBS
+    prod = [None] * (2 * n)
+    for i in range(n):
+        t = a[i] * a[i]
+        k = 2 * i
+        prod[k] = t if prod[k] is None else prod[k] + t
+        for j in range(i + 1, n):
+            t = a[i] * (a[j] + a[j])
+            k = i + j
+            prod[k] = t if prod[k] is None else prod[k] + t
+    zero = a[0] - a[0]
+    prod[2 * n - 1] = zero
+    for i in range(n):
+        m = (prod[i] & MASK) * PPRIME & MASK
+        for j in range(n):
+            prod[i + j] = prod[i + j] + m * P_LIMBS[j]
+        prod[i + 1] = prod[i + 1] + (prod[i] >> RADIX)
+    return carry_rows(prod[n : 2 * n], passes=3)
+
+
+def select_rows(cond, a: Rows, b: Rows, xp=np) -> Rows:
+    """cond ? a : b elementwise over the batch (cond: bool batch array)."""
+    return [xp.where(cond, x, y) for x, y in zip(a, b)]
+
+
+def is_zero_val(rows: Rows) -> np.ndarray:
+    """Batch bool: value ≡ 0 mod p. HOST-side (numpy) only: used at the
+    tiny result boundary (one point / a few lanes), not in kernels."""
+    arr = np.asarray([np.asarray(r, dtype=np.int64) for r in rows])
+    flat = arr.reshape(NLIMBS, -1)
+    out = np.zeros(flat.shape[1], dtype=bool)
+    for j in range(flat.shape[1]):
+        v = sum(int(flat[i, j]) << (RADIX * i) for i in range(NLIMBS))
+        out[j] = v % P == 0
+    return out.reshape(arr.shape[1:])
+
+
+# convenience wrappers on stacked (33, ...batch) arrays
+
+
+def mul(a, b, xp=np):
+    return stack(mul_rows(rows_of(a), rows_of(b)), xp)
+
+
+def add(a, b, xp=np):
+    return stack(add_rows(rows_of(a), rows_of(b)), xp)
+
+
+def sub(a, b, xp=np):
+    return stack(sub_rows(rows_of(a), rows_of(b)), xp)

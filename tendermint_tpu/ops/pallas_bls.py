@@ -1,0 +1,397 @@
+"""Miller-loop line evaluations + Fp12 tower arithmetic: Pallas kernels & twins.
+
+The pairing half of the BLS device backend (the MSM half is
+ops/bls12_msm.py). The optimal ate pairing is ~64 doubling steps of
+
+    f <- f^2 * line_{T,T}(P) ;  T <- 2T      (+ a sparse add-step on the
+                                               6 set bits of |x|)
+
+and every term is batched-field-multiply shaped — exactly the workload the
+fused ed25519 pipeline already runs at 160 G int32-mul/s. This module
+provides, in the pallas_fe idiom:
+
+- CPU-TWIN tower ops over ops/fp381 limb rows: Fp2 (Karatsuba, 3 base
+  muls), the w-basis Fp12 (6 Fp2 coefficients over {1..w^5}, w^6 = XI),
+  full/sparse Fp12 products, and the DIVISION-FREE projective line
+  coefficients for both Miller steps. Lines are kept in the M-twist sparse
+  form (c0, c3, c5) — the only nonzero w-basis coefficients of an
+  untwisted line — so the f update is an 18-Fp2-mul sparse product
+  instead of a 36-mul full one.
+- A full `miller_loop_rows` twin (batched over independent (P, Q) lanes)
+  whose output equals crypto/bls_ref.miller_loop up to the subfield
+  factors that die in the final exponentiation; tests pin
+  final_exp(kernel twin) == final_exp(bls_ref) on real curve points.
+- The Pallas kernels themselves: `fp381_mul` (the base-field Montgomery
+  product every stage is made of) and `fp12_sparse_mul` (one fused
+  f * line step). Layout matches pallas_fe: int32[NLIMBS, S, 128], limb
+  rows as full (sublane, lane) tiles; enabled on TPU (TMTPU_PALLAS=0
+  disables, =interpret runs the Mosaic interpreter).
+
+Projective line derivation (recorded because the twist wiring is the
+error-prone part): with the M-twist untwist (x', y') -> (x'/w^2, y'/w^3)
+and w^-2 = XI^-1 v w^... the line through the untwisted T at P = (xP, yP):
+
+    l(P) = yP + (lam*x_T - y_T) * XI^-1 * w^3 - lam*xP * XI^-1 * w^5
+
+Scaling by the Fp2 subfield factors 2YZ^2 (doubling, lam = 3X^2/2YZ) or
+X - xQ*Z (addition, lam = (Y - yQ*Z)/(X - xQ*Z)) makes the coefficients
+polynomial — subfield scale factors are killed by the final exponentiation
+(their order divides p^2 - 1, which divides (p^12 - 1)/r):
+
+    dbl:  c0 = 2*Y*Z^2*yP         add:  c0 = (X - xQ*Z)*yP
+          c3 = (3X^3 - 2Y^2*Z)*XI^-1    c3 = ((Y - yQ*Z)*xQ - (X - xQ*Z)*yQ)*XI^-1
+          c5 = -(3X^2*Z*xP)*XI^-1       c5 = -((Y - yQ*Z)*xP)*XI^-1
+
+T itself advances with the complete RCB addition over Fp2 (b3 = 12*XI),
+so the step needs no exceptional-case lanes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from tendermint_tpu.ops import fp381 as F
+
+NLIMBS = F.NLIMBS
+LANE = 128
+BLK = 8  # sublane groups per grid step
+
+# Fp2 element: (c0_rows, c1_rows). Fp12 element: list of 6 Fp2 (w-basis).
+Fp2Rows = Tuple[List, List]
+
+# XI^-1 = (1 + u)^-1 = (1 - u)/2: components (1/2, -1/2)
+_INV2 = pow(2, F.P - 2, F.P)
+XI_INV_C0 = _INV2
+XI_INV_C1 = (-_INV2) % F.P
+X_PARAM_ABS = 0xD201000000010000
+
+
+def _const2(c0: int, c1: int, batch_shape, xp=np) -> Fp2Rows:
+    def bc(v):
+        limbs = F.mont_from_int(v)
+        a = xp.broadcast_to(
+            xp.asarray(limbs).reshape((NLIMBS,) + (1,) * len(batch_shape)),
+            (NLIMBS, *batch_shape),
+        ).astype(np.int32)
+        return [a[i] for i in range(NLIMBS)]
+
+    return (bc(c0), bc(c1))
+
+
+# --------------------------------------------------------------------------
+# Fp2 over limb rows
+
+
+def add2(a: Fp2Rows, b: Fp2Rows) -> Fp2Rows:
+    return (F.add_rows(a[0], b[0]), F.add_rows(a[1], b[1]))
+
+
+def sub2(a: Fp2Rows, b: Fp2Rows) -> Fp2Rows:
+    return (F.sub_rows(a[0], b[0]), F.sub_rows(a[1], b[1]))
+
+
+def mul2(a: Fp2Rows, b: Fp2Rows) -> Fp2Rows:
+    """Karatsuba: 3 base-field Montgomery muls."""
+    t0 = F.mul_rows(a[0], b[0])
+    t1 = F.mul_rows(a[1], b[1])
+    t2 = F.mul_rows(F.add_rows(a[0], a[1]), F.add_rows(b[0], b[1]))
+    # c0 = t0 - t1 ; c1 = t2 - t0 - t1  (subtrahends are mul/add outputs)
+    return (F.sub_rows(t0, t1), F.sub_rows(t2, F.add_rows(t0, t1)))
+
+
+def square2(a: Fp2Rows) -> Fp2Rows:
+    return mul2(a, a)
+
+
+def mul2_small(a: Fp2Rows, k: int) -> Fp2Rows:
+    return (F.mul_small_rows(a[0], k), F.mul_small_rows(a[1], k))
+
+
+def mul2_by_xi(a: Fp2Rows) -> Fp2Rows:
+    """(c0 + c1 u)(1 + u) = (c0 - c1) + (c0 + c1) u. Both components are
+    folded (sub folds internally) so a xi output is subtrahend-safe."""
+    return (
+        F.sub_rows(a[0], a[1]),
+        F.fold_top_rows(F.add_rows(a[0], a[1])),
+    )
+
+
+def mul2_fp(a: Fp2Rows, s: List) -> Fp2Rows:
+    """Fp2 * base-field scalar rows (per-lane)."""
+    return (F.mul_rows(a[0], s), F.mul_rows(a[1], s))
+
+
+def neg2(a: Fp2Rows) -> Fp2Rows:
+    z = [r - r for r in a[0]]
+    return (F.sub_rows(z, a[0]), F.sub_rows(z, a[1]))
+
+
+# --------------------------------------------------------------------------
+# complete G2 point addition (RCB alg 7 over Fp2, b3 = 12 * XI)
+
+
+def padd2(p, q):
+    """p, q: (X, Y, Z) Fp2Rows triples, homogeneous projective; complete."""
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    t0 = mul2(X1, X2)
+    t1 = mul2(Y1, Y2)
+    t2 = mul2(Z1, Z2)
+    t3 = sub2(mul2(add2(X1, Y1), add2(X2, Y2)), add2(t0, t1))
+    t4 = sub2(mul2(add2(Y1, Z1), add2(Y2, Z2)), add2(t1, t2))
+    t0_3 = add2(add2(t0, t0), t0)
+    # b3 * t2 with the *12 BEFORE the xi twist: scaling a xi output (whose
+    # c0 is a sub result) by 12 would exceed the Montgomery value bound
+    t2b = mul2_by_xi(mul2_small(t2, 12))
+    z3 = add2(t1, t2b)
+    t1s = sub2(t1, t2b)
+    # y3 = b3 * (X1Z2 + X2Z1), b3 distributed into both sub operands
+    txz = mul2(add2(X1, Z1), add2(X2, Z2))
+    y3 = sub2(
+        mul2_by_xi(mul2_small(txz, 12)), mul2_by_xi(mul2_small(add2(t0, t2), 12))
+    )
+    X3 = sub2(mul2(t3, t1s), mul2(t4, y3))
+    Y3 = add2(mul2(t1s, z3), mul2(y3, t0_3))
+    Z3 = add2(mul2(z3, t4), mul2(t0_3, t3))
+    return (X3, Y3, Z3)
+
+
+# --------------------------------------------------------------------------
+# Fp12 (w-basis: 6 Fp2 coefficients, w^6 = XI)
+
+
+def mul12(a: Sequence[Fp2Rows], b: Sequence[Fp2Rows]) -> List[Fp2Rows]:
+    """Full 6x6 w-basis product with XI folding for powers >= 6."""
+    acc = [None] * 11
+    for i in range(6):
+        for j in range(6):
+            t = mul2(a[i], b[j])
+            k = i + j
+            acc[k] = t if acc[k] is None else add2(acc[k], t)
+    out = []
+    for k in range(6):
+        hi = acc[k + 6] if k + 6 < 11 else None
+        out.append(add2(acc[k], mul2_by_xi(hi)) if hi is not None else acc[k])
+    return out
+
+
+def square12(a: Sequence[Fp2Rows]) -> List[Fp2Rows]:
+    return mul12(a, a)
+
+
+def sparse_mul12(f: Sequence[Fp2Rows], line) -> List[Fp2Rows]:
+    """f * (c0 + c3 w^3 + c5 w^5): 18 Fp2 muls."""
+    c0, c3, c5 = line
+    acc = [None] * 11
+    for j, c in ((0, c0), (3, c3), (5, c5)):
+        for i in range(6):
+            t = mul2(f[i], c)
+            k = i + j
+            acc[k] = t if acc[k] is None else add2(acc[k], t)
+    out = []
+    for k in range(6):
+        hi = acc[k + 6] if k + 6 < 11 else None
+        out.append(add2(acc[k], mul2_by_xi(hi)) if hi is not None else acc[k])
+    return out
+
+
+def conj12(a: Sequence[Fp2Rows]) -> List[Fp2Rows]:
+    """x -> x^(p^6): negate the odd w-basis coefficients."""
+    return [c if m % 2 == 0 else neg2(c) for m, c in enumerate(a)]
+
+
+def one12(batch_shape, xp=np) -> List[Fp2Rows]:
+    one = _const2(1, 0, batch_shape, xp)
+    zero = _const2(0, 0, batch_shape, xp)
+    return [one] + [zero] * 5
+
+
+# --------------------------------------------------------------------------
+# Miller-loop line coefficients (sparse (c0, c3, c5); see module docstring)
+
+
+def line_dbl(T, xP: List, yP: List, xi_inv: Fp2Rows):
+    X, Y, Z = T
+    X2 = mul2(X, X)
+    Y2 = mul2(Y, Y)
+    Z2 = mul2(Z, Z)
+    X2_3 = add2(add2(X2, X2), X2)  # 3X^2
+    YZ2 = mul2(Y, Z2)
+    c0 = mul2_fp(add2(YZ2, YZ2), yP)  # 2YZ^2 * yP
+    # 3X^3 - 2Y^2 Z
+    t = sub2(mul2(X2_3, X), mul2(add2(Y2, Y2), Z))
+    c3 = mul2(t, xi_inv)
+    c5 = mul2(neg2(mul2_fp(mul2(X2_3, Z), xP)), xi_inv)
+    return (c0, c3, c5)
+
+
+def line_add(T, Qx: Fp2Rows, Qy: Fp2Rows, xP: List, yP: List, xi_inv: Fp2Rows):
+    X, Y, Z = T
+    N = sub2(Y, mul2(Qy, Z))  # Y - yQ Z
+    D = sub2(X, mul2(Qx, Z))  # X - xQ Z
+    c0 = mul2_fp(D, yP)
+    c3 = mul2(sub2(mul2(N, Qx), mul2(D, Qy)), xi_inv)
+    c5 = mul2(neg2(mul2_fp(N, xP)), xi_inv)
+    return (c0, c3, c5)
+
+
+def miller_loop_rows(
+    q_coords: Sequence[Tuple[int, int, int, int]],
+    p_coords: Sequence[Tuple[int, int]],
+    xp=np,
+) -> List[Fp2Rows]:
+    """Batched Miller loop over independent lanes.
+
+    q_coords: affine G2 points as (x_c0, x_c1, y_c0, y_c1) ints;
+    p_coords: affine G1 points as (x, y) ints. Returns the UNREDUCED
+    pairing values (w-basis Fp12 rows) — equal to bls_ref.miller_loop up
+    to subfield factors; apply bls_ref.final_exponentiation to compare."""
+    n = len(q_coords)
+    if n != len(p_coords):
+        raise ValueError("q/p length mismatch")
+
+    def fp_rows(vals):
+        arr = np.zeros((NLIMBS, n), dtype=np.int32)
+        for j, v in enumerate(vals):
+            arr[:, j] = F.mont_from_int(v)
+        a = xp.asarray(arr)
+        return [a[i] for i in range(NLIMBS)]
+
+    Qx = (fp_rows([q[0] for q in q_coords]), fp_rows([q[1] for q in q_coords]))
+    Qy = (fp_rows([q[2] for q in q_coords]), fp_rows([q[3] for q in q_coords]))
+    xP = fp_rows([p[0] for p in p_coords])
+    yP = fp_rows([p[1] for p in p_coords])
+    one2 = _const2(1, 0, (n,), xp)
+    xi_inv = _const2(XI_INV_C0, XI_INV_C1, (n,), xp)
+    T = (Qx, Qy, one2)
+    f = one12((n,), xp)
+    for bit in bin(X_PARAM_ABS)[3:]:
+        f = sparse_mul12(square12(f), line_dbl(T, xP, yP, xi_inv))
+        T = padd2(T, T)
+        if bit == "1":
+            f = sparse_mul12(f, line_add(T, Qx, Qy, xP, yP, xi_inv))
+            T = padd2(T, (Qx, Qy, one2))
+    # negative BLS parameter: conjugate (bls_ref.miller_loop does the same)
+    return conj12(f)
+
+
+def fp12_rows_to_ref(f: Sequence[Fp2Rows], lane: int = 0):
+    """One lane -> a bls_ref.Fp12 (for final exponentiation / comparison)."""
+    from tendermint_tpu.crypto import bls_ref as B
+
+    coeffs = []
+    for c in f:
+        c0 = F.mont_to_ints(np.stack([np.asarray(r) for r in c[0]]).reshape(NLIMBS, -1)[:, lane : lane + 1])[0]
+        c1 = F.mont_to_ints(np.stack([np.asarray(r) for r in c[1]]).reshape(NLIMBS, -1)[:, lane : lane + 1])[0]
+        coeffs.append(B.Fp2(c0, c1))
+    return B.Fp12.from_wcoeffs(coeffs)
+
+
+# --------------------------------------------------------------------------
+# Pallas kernels (TPU; gated exactly like ops/pallas_fe.py)
+
+
+def _mode() -> str:
+    return os.environ.get("TMTPU_PALLAS", "auto")
+
+
+def enabled() -> bool:
+    m = _mode()
+    if m == "0":
+        return False
+    if m == "interpret":
+        return True
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _interpret() -> bool:
+    return _mode() == "interpret"
+
+
+def _fp381_mul_kernel(a_ref, b_ref, o_ref):
+    """One VMEM-resident Montgomery product over a (NLIMBS, BLK, 128)
+    block: the row-list algorithm of fp381._mul_rows_loop verbatim — every
+    intermediate stays in registers/VMEM instead of 65 HBM-materialized
+    accumulator rows (the fe25519 lesson, pallas_fe.py)."""
+    a = [a_ref[i] for i in range(NLIMBS)]
+    b = [b_ref[i] for i in range(NLIMBS)]
+    out = F._mul_rows_loop(a, b)
+    for i in range(NLIMBS):
+        o_ref[i] = out[i]
+
+
+def fp381_mul(a, b):
+    """Batched base-field product via the Pallas kernel. a, b: int32
+    (NLIMBS, S, 128) (lane-tiled; wrappers pad like pallas_fe)."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s = a.shape[1]
+    grid = (max(1, s // BLK),)
+    blk = min(BLK, s)
+    spec = pl.BlockSpec(
+        (NLIMBS, blk, LANE), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+    )
+    return pl.pallas_call(
+        _fp381_mul_kernel,
+        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        grid=grid,
+        in_specs=[spec, spec],
+        out_specs=spec,
+        interpret=_interpret(),
+    )(a, b)
+
+
+def _fp12_sparse_mul_kernel(*refs):
+    """f (12 row-planes: 6 Fp2 coeffs x 2 components) * sparse line
+    (c0, c3, c5): one fused kernel per grid block — 18 Fp2 products whose
+    intermediates never leave VMEM."""
+    f_refs, line_refs, out_refs = refs[:12], refs[12:18], refs[18:]
+    f = [
+        ([f_refs[2 * m][i] for i in range(NLIMBS)], [f_refs[2 * m + 1][i] for i in range(NLIMBS)])
+        for m in range(6)
+    ]
+    line = [
+        ([line_refs[2 * m][i] for i in range(NLIMBS)], [line_refs[2 * m + 1][i] for i in range(NLIMBS)])
+        for m in range(3)
+    ]
+    out = sparse_mul12(f, line)
+    for m in range(6):
+        for i in range(NLIMBS):
+            out_refs[2 * m][i] = out[m][0][i]
+            out_refs[2 * m + 1][i] = out[m][1][i]
+
+
+def fp12_sparse_mul(f_planes, line_planes):
+    """f_planes: 12 arrays (NLIMBS, S, 128); line_planes: 6 arrays same
+    shape. Returns 12 output planes."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s = f_planes[0].shape[1]
+    grid = (max(1, s // BLK),)
+    blk = min(BLK, s)
+    spec = pl.BlockSpec(
+        (NLIMBS, blk, LANE), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+    )
+    return pl.pallas_call(
+        _fp12_sparse_mul_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct(f_planes[0].shape, f_planes[0].dtype)
+            for _ in range(12)
+        ],
+        grid=grid,
+        in_specs=[spec] * 18,
+        out_specs=[spec] * 12,
+        interpret=_interpret(),
+    )(*f_planes, *line_planes)

@@ -553,3 +553,44 @@ def shard_batch_arrays(mesh: Mesh, *arrays):
         spec = P(None, *_aligned(mesh, a.ndim - 1))
         out.append(jax.device_put(a, NamedSharding(mesh, spec)))
     return tuple(out)
+
+
+def aggregate_bitmap_sharded(coords, bitmap, n_shards: int | None = None):
+    """Sharded BLS aggregate-pubkey fold (ISSUE 14): partition the signer
+    coordinate list across shards, run the bitmap MSM fold per shard
+    (ops/bls12_msm.g1_aggregate_bitmap — the same kernel schedule a mesh
+    device would run per shard via shard_map), and combine the per-shard
+    partial sums with ONE final O(n_shards) reduction — the exact shape of
+    sharded_rlc_check: per-shard accumulation, one cross-shard combine.
+
+    coords: [(x, y)] affine G1 ints; bitmap: per-index booleans. Returns
+    affine (x, y) ints or None (empty selection). Host-combining via
+    bls_ref keeps this correct on any backend; on a real mesh each shard's
+    fold dispatches to its device and the combine stays O(devices)."""
+    from tendermint_tpu.crypto import bls_ref
+    from tendermint_tpu.ops import bls12_msm
+
+    n = len(coords)
+    if n != len(bitmap):
+        raise ValueError("coords/bitmap length mismatch")
+    if n_shards is None:
+        try:
+            n_shards = max(1, len(jax.devices()))
+        except Exception:  # pragma: no cover - jax init failure
+            n_shards = 1
+    n_shards = max(1, min(n_shards, n or 1))
+    per = (n + n_shards - 1) // n_shards
+    acc = bls_ref.G1_IDENTITY
+    for s in range(n_shards):
+        sl = slice(s * per, min((s + 1) * per, n))
+        if sl.start >= n:
+            break
+        part = bls12_msm.g1_aggregate_bitmap(coords[sl], bitmap[sl])
+        if part is None:
+            continue
+        acc = bls_ref._jac_add(
+            acc,
+            (bls_ref._G1Field(part[0]), bls_ref._G1Field(part[1]), bls_ref._G1Field(1)),
+        )
+    aff = bls_ref._jac_to_affine(acc)
+    return None if aff is None else (aff[0].v, aff[1].v)

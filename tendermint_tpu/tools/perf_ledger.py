@@ -77,6 +77,11 @@ def _scenario_speedups(extra: dict) -> Dict[str, Any]:
             if isinstance(res.get(key), (int, float)):
                 entry["speedup"] = res[key]
                 break
+        # signature-scheme backend column (ISSUE 14): scenarios stamp
+        # `backend` so e.g. BLS aggregate numbers render in their own
+        # column and never fold into the ed25519 RLC headline trajectory
+        if isinstance(res.get("backend"), str):
+            entry["backend"] = res["backend"]
         if isinstance(res.get("tpu_e2e_ms"), (int, float)):
             entry["tpu_e2e_ms"] = res["tpu_e2e_ms"]
         if isinstance(res.get("sigs_per_sec"), (int, float)):
@@ -308,13 +313,16 @@ def render_markdown(ledger: dict) -> str:
             if name not in scen_names:
                 scen_names.append(name)
     if scen_names:
-        lines.append("| scenario | " + " | ".join(
+        lines.append("| scenario | backend | " + " | ".join(
             _round_label(r) for r in ledger["bench"]) + " |")
-        lines.append("|---|" + "---:|" * len(ledger["bench"]))
+        lines.append("|---|---|" + "---:|" * len(ledger["bench"]))
         for name in scen_names:
             cells = []
+            backend = "ed25519"  # pre-ISSUE-14 scenarios are all ed25519/RLC
             for r in ledger["bench"]:
                 s = r["scenarios"].get(name)
+                if s and s.get("backend"):
+                    backend = s["backend"]
                 if not s:
                     cells.append("—")
                 elif s.get("degraded"):
@@ -325,7 +333,7 @@ def render_markdown(ledger: dict) -> str:
                     cells.append(f"{s['sigs_per_sec']:,}/s")
                 else:
                     cells.append("·")
-            lines.append(f"| {name} | " + " | ".join(cells) + " |")
+            lines.append(f"| {name} | {backend} | " + " | ".join(cells) + " |")
     else:
         lines.append("(no per-scenario data)")
     lines += [

@@ -98,6 +98,8 @@ class Validator:
             pk.bytes_field(1, self.pub_key.bytes())
         elif self.pub_key.type_name() == "sr25519":
             pk.bytes_field(3, self.pub_key.bytes())
+        elif self.pub_key.type_name() == "bls12_381":
+            pk.bytes_field(4, self.pub_key.bytes())
         else:
             raise ValueError(f"unsupported key type {self.pub_key.type_name()}")
         w = pw.Writer()
@@ -447,3 +449,116 @@ class ValidatorSet:
         """Trust-level verification against a possibly different validator set
         (reference: types/validator_set.go:772-830)."""
         self.begin_verify_commit_light_trusting(chain_id, commit, trust_level)()
+
+    # -- BLS aggregate-commit verification (ISSUE 14) -----------------------
+
+    def verify_aggregate_commit(self, chain_id: str, block_id, height: int, commit) -> None:
+        """VerifyAggregateCommit: ONE pairing check + ONE bitmap-weighted
+        aggregate-pubkey MSM, against the single canonical message every
+        signer signed (types/block.AggregateCommit). No reference
+        counterpart — the reference has no aggregate signatures at all.
+
+        Routing: a plain Commit routes through verify_commit (the existing
+        verify_batch ladder — device RLC, breaker, QoS lanes), so callers
+        can pass whatever the wire delivered. The aggregate path:
+
+        1. every bitmap validator must hold a bls12_381 key WITH a
+           verified proof of possession (crypto/keys.register_pop) — the
+           rogue-key defense; an unregistered key fails the commit, it is
+           never silently skipped;
+        2. apk = sum of signer pubkeys via the device-schedule MSM twin
+           (ops/bls12_msm.g1_aggregate_bitmap; decompressed coordinates
+           cached across heights like the ed25519 A cache);
+        3. e(-g1, sigma) * e(apk, H(msg)) == 1 (bls_ref pairing);
+        4. signer voting power must exceed 2/3 of the total.
+
+        Raises CommitVerifyError / NotEnoughVotingPowerError like the
+        other Verify* entries."""
+        from tendermint_tpu.crypto import bls_ref
+        from tendermint_tpu.crypto.batch import record_backend_rows
+        from tendermint_tpu.crypto.keys import pop_verified
+        from tendermint_tpu.libs import metrics as _metrics
+        from tendermint_tpu.ops import bls12_msm
+        from tendermint_tpu.types.block import AggregateCommit
+
+        if not isinstance(commit, AggregateCommit):
+            return self.verify_commit(chain_id, block_id, height, commit)
+        commit.validate_basic()
+        if height != commit.height:
+            raise CommitVerifyError(
+                f"invalid commit -- wrong height: {height} vs {commit.height}"
+            )
+        if block_id != commit.block_id:
+            raise CommitVerifyError(
+                f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
+            )
+        idxs = commit.signer_indices()
+        if idxs and idxs[-1] >= self.size():
+            raise CommitVerifyError(
+                f"invalid commit -- signer index {idxs[-1]} out of range ({self.size()} validators)"
+            )
+        coords, powers = [], []
+        for i in idxs:
+            val = self.validators[i]
+            if val.pub_key.type_name() != "bls12_381":
+                raise CommitVerifyError(
+                    f"invalid commit -- validator #{i} is {val.pub_key.type_name()}, "
+                    "cannot join a BLS aggregate"
+                )
+            if not pop_verified(val.pub_key.bytes()):
+                raise CommitVerifyError(
+                    f"invalid commit -- validator #{i} has no verified proof of "
+                    "possession (rogue-key defense)"
+                )
+            coords.append(_bls_pubkey_coords(val.pub_key.bytes()))
+            powers.append(val.voting_power)
+        record_backend_rows("bls12_381", len(idxs))
+        m = _metrics.batch_metrics()
+        m.aggregate_size.set(len(idxs))
+        apk = bls12_msm.g1_aggregate_bitmap(coords, [True] * len(coords))
+        if apk is None:
+            raise CommitVerifyError("invalid commit -- empty aggregate pubkey")
+        sig = bls_ref.g2_from_bytes(commit.agg_signature)
+        if sig is None:
+            raise CommitVerifyError("invalid commit -- malformed aggregate signature")
+        apk_jac = (
+            bls_ref._G1Field(apk[0]),
+            bls_ref._G1Field(apk[1]),
+            bls_ref._G1Field(1),
+        )
+        msg = commit.sign_bytes(chain_id)
+        ok = bls_ref.pairings_are_one(
+            [
+                (bls_ref._jac_neg(bls_ref.G1_GEN), sig),
+                (apk_jac, bls_ref.hash_to_g2(msg)),
+            ]
+        )
+        if not ok:
+            raise CommitVerifyError("invalid commit -- aggregate signature mismatch")
+        tallied = sum(powers)
+        needed = self.total_voting_power() * 2 // 3
+        if tallied <= needed:
+            raise NotEnoughVotingPowerError(tallied, needed)
+
+
+# Decompressed BLS pubkey coordinate cache: consensus re-verifies the same
+# validator set every height, and the 48-byte -> affine decompression (one
+# field sqrt + subgroup check) is the per-key host cost worth amortizing —
+# the exact shape of crypto/batch.py's ed25519 A cache.
+_BLS_COORD_CACHE: Dict[bytes, Tuple[int, int]] = {}
+
+
+def _bls_pubkey_coords(pk_bytes: bytes) -> Tuple[int, int]:
+    got = _BLS_COORD_CACHE.get(pk_bytes)
+    if got is not None:
+        return got
+    from tendermint_tpu.crypto import bls_ref
+
+    pt = bls_ref.g1_from_bytes(pk_bytes)
+    if pt is None:
+        raise CommitVerifyError("invalid bls12_381 pubkey in validator set")
+    aff = bls_ref._jac_to_affine(pt)
+    got = (aff[0].v, aff[1].v)
+    if len(_BLS_COORD_CACHE) < 1 << 20:
+        _BLS_COORD_CACHE[bytes(pk_bytes)] = got
+    return got

@@ -91,7 +91,10 @@ class Vote:
             raise ValueError("negative validator index")
         if not self.signature:
             raise ValueError("signature is missing")
-        if len(self.signature) > 64:
+        # 96 = compressed-G2 BLS signature; ed25519/sr25519 remain 64
+        # (reference caps at MaxSignatureSize=64; raised for the BLS
+        # aggregate backend, docs/BLS.md)
+        if len(self.signature) > 96:
             raise ValueError("signature too big")
 
     def with_signature(self, sig: bytes) -> "Vote":

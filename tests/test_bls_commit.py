@@ -1,0 +1,306 @@
+"""ValidatorSet.verify_aggregate_commit + mixed-backend valsets (ISSUE 14).
+
+Covers the acceptance criteria: device-path (ops/bls12_msm twin) verdicts
+byte-identical to a pure bls_ref recomputation on real curve points —
+including tampered-signature and rogue-key (no-PoP) rejections — the
+per-signature fallback routing, the mixed ed25519+BLS validator set path
+with a corrupted row in each arm, and the new backend/aggregate metrics.
+"""
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import bls_ref as B
+from tendermint_tpu.crypto import keys as K
+from tendermint_tpu.crypto.batch import verify_batch
+from tendermint_tpu.libs import metrics as M
+from tendermint_tpu.ops import bls12_msm
+from tendermint_tpu.types.basic import BlockID, BlockIDFlag, PartSetHeader, SignedMsgType
+from tendermint_tpu.types.block import AggregateCommit, Commit, CommitSig
+from tendermint_tpu.types.validator_set import (
+    CommitVerifyError,
+    NotEnoughVotingPowerError,
+    Validator,
+    ValidatorSet,
+)
+from tendermint_tpu.types.vote import Vote
+
+CHAIN = "bls-commit-chain"
+BID = BlockID(b"\x07" * 32, PartSetHeader(1, b"\x08" * 32))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pop_registry():
+    K.clear_pop_registry()
+    yield
+    K.clear_pop_registry()
+
+
+def bls_valset(n, power=10, seed=0x50):
+    privs = [K.gen_bls12_381(bytes([seed + i]) * 32) for i in range(n)]
+    vals = ValidatorSet([Validator(p.pub_key(), power) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    ordered = [by_addr[v.address] for v in vals.validators]
+    return vals, ordered
+
+
+def register_all(privs):
+    for p in privs:
+        assert K.register_pop(p.pub_key().bytes(), p.pop_prove())
+
+
+def make_agg(vals, privs, idxs, height=5, ts=123456789, chain=CHAIN):
+    proto = AggregateCommit(
+        height, 0, BID, ts, AggregateCommit.bitmap_of(idxs, vals.size()), b"\x00" * 96
+    )
+    msg = proto.sign_bytes(chain)
+    sig = B.aggregate_signatures([privs[i].sign(msg) for i in idxs])
+    return dataclasses.replace(proto, agg_signature=sig)
+
+
+def ref_verdict(vals, agg, chain=CHAIN) -> bool:
+    """Pure-bls_ref recomputation of the aggregate check (the referee)."""
+    idxs = agg.signer_indices()
+    pks = [vals.validators[i].pub_key.bytes() for i in idxs]
+    if not all(K.pop_verified(pk) for pk in pks):
+        return False
+    if not B.fast_aggregate_verify(pks, agg.sign_bytes(chain), agg.agg_signature):
+        return False
+    tallied = sum(vals.validators[i].voting_power for i in idxs)
+    return tallied > vals.total_voting_power() * 2 // 3
+
+
+def kernel_verdict(vals, agg, chain=CHAIN) -> bool:
+    try:
+        vals.verify_aggregate_commit(chain, BID, agg.height, agg)
+        return True
+    except (CommitVerifyError, NotEnoughVotingPowerError):
+        return False
+
+
+def test_aggregate_commit_accepts_and_apk_byte_identical():
+    vals, privs = bls_valset(7)
+    register_all(privs)
+    agg = make_agg(vals, privs, list(range(7)))
+    vals.verify_aggregate_commit(CHAIN, BID, 5, agg)
+    # the device-schedule MSM twin's aggregate pubkey is BYTE-identical to
+    # bls_ref's jacobian aggregation (compressed-G1 encoding compared)
+    idxs = agg.signer_indices()
+    coords = []
+    for i in idxs:
+        pt = B.g1_from_bytes(vals.validators[i].pub_key.bytes())
+        a = B._jac_to_affine(pt)
+        coords.append((a[0].v, a[1].v))
+    apk = bls12_msm.g1_aggregate_bitmap(coords, [True] * len(coords))
+    apk_jac = (B._G1Field(apk[0]), B._G1Field(apk[1]), B._G1Field(1))
+    ref = B.aggregate_pubkeys([vals.validators[i].pub_key.bytes() for i in idxs])
+    assert B.g1_to_bytes(apk_jac) == B.g1_to_bytes(ref)
+
+
+def test_device_vs_ref_verdicts_byte_identical():
+    """Acceptance criterion: kernel-path and bls_ref verdicts agree on real
+    curve points for valid / tampered / rogue-key / subthreshold cases."""
+    vals, privs = bls_valset(6)
+    register_all(privs)
+    good = make_agg(vals, privs, list(range(6)))
+    tampered = dataclasses.replace(
+        good,
+        agg_signature=bytes(
+            bytearray(good.agg_signature[:-1]) + bytes([good.agg_signature[-1] ^ 1])
+        ),
+    )
+    subthreshold = make_agg(vals, privs, [0, 1])
+    cases = [good, tampered, subthreshold]
+    for agg in cases:
+        assert kernel_verdict(vals, agg) == ref_verdict(vals, agg)
+    assert kernel_verdict(vals, good) is True
+    assert kernel_verdict(vals, tampered) is False
+    # rogue-key: drop one signer's PoP -> both sides must now reject
+    K.clear_pop_registry()
+    register_all(privs[:-1])
+    assert kernel_verdict(vals, good) is False
+    assert ref_verdict(vals, good) is False
+
+
+def test_aggregate_commit_structural_rejections():
+    vals, privs = bls_valset(4)
+    register_all(privs)
+    agg = make_agg(vals, privs, [0, 1, 2, 3])
+    with pytest.raises(CommitVerifyError):
+        vals.verify_aggregate_commit(CHAIN, BID, 6, agg)  # wrong height
+    with pytest.raises(CommitVerifyError):
+        vals.verify_aggregate_commit(
+            CHAIN, BlockID(b"\x09" * 32, PartSetHeader(1, b"\x08" * 32)), 5, agg
+        )
+    # out-of-range signer bit
+    bad = dataclasses.replace(agg, signers=b"\xff\xff")
+    with pytest.raises(CommitVerifyError):
+        vals.verify_aggregate_commit(CHAIN, BID, 5, bad)
+    # malformed aggregate signature bytes
+    bad = dataclasses.replace(agg, agg_signature=b"\x00" * 96)
+    with pytest.raises(CommitVerifyError):
+        vals.verify_aggregate_commit(CHAIN, BID, 5, bad)
+    # a different canonical timestamp changes the signed message
+    bad = dataclasses.replace(agg, timestamp_ns=agg.timestamp_ns + 1)
+    with pytest.raises(CommitVerifyError):
+        vals.verify_aggregate_commit(CHAIN, BID, 5, bad)
+
+
+def test_aggregate_commit_codec_round_trip():
+    vals, privs = bls_valset(4)
+    register_all(privs)
+    agg = make_agg(vals, privs, [0, 2])
+    assert AggregateCommit.decode(agg.encode()) == agg
+    assert agg.signer_indices() == [0, 2]
+    assert agg.has_signer(2) and not agg.has_signer(1) and not agg.has_signer(99)
+
+
+def ed_commit(vals, privs, height=5, corrupt_idx=None):
+    css = []
+    for i, (v, p) in enumerate(zip(vals.validators, privs)):
+        vote = Vote(
+            type=SignedMsgType.PRECOMMIT,
+            height=height,
+            round=0,
+            block_id=BID,
+            timestamp_ns=1,
+            validator_address=v.address,
+            validator_index=i,
+        )
+        sig = p.sign(vote.sign_bytes(CHAIN))
+        if i == corrupt_idx:
+            sig = bytes(bytearray(sig[:-1]) + bytes([sig[-1] ^ 1]))
+        css.append(CommitSig(BlockIDFlag.COMMIT, v.address, 1, sig))
+    return Commit(height, 0, BID, tuple(css))
+
+
+def test_plain_commit_fallback_routes_through_verify_batch_ladder():
+    privs = [K.gen_ed25519(bytes([i + 1]) * 32) for i in range(4)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    ordered = [{p.pub_key().address(): p for p in privs}[v.address] for v in vals.validators]
+    commit = ed_commit(vals, ordered)
+    # verify_aggregate_commit with a plain Commit == verify_commit
+    vals.verify_aggregate_commit(CHAIN, BID, 5, commit)
+    with pytest.raises(CommitVerifyError):
+        vals.verify_aggregate_commit(CHAIN, BID, 5, ed_commit(vals, ordered, corrupt_idx=2))
+
+
+# -- mixed-backend validator sets (satellite) --------------------------------
+
+
+def mixed_valset(n_ed=3, n_bls=3):
+    ed = [K.gen_ed25519(bytes([i + 1]) * 32) for i in range(n_ed)]
+    bls = [K.gen_bls12_381(bytes([i + 0x70]) * 32) for i in range(n_bls)]
+    privs = ed + bls
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return vals, [by_addr[v.address] for v in vals.validators]
+
+
+def test_mixed_ed25519_bls_commit_verifies_per_type():
+    """A valset holding BOTH ed25519 and BLS validators verifies a plain
+    commit through the per-type split (ed rows -> the batch ladder, BLS
+    rows -> bls_ref), mirroring the existing ed25519/sr25519 mixed path."""
+    vals, ordered = mixed_valset()
+    commit = ed_commit(vals, ordered)
+    vals.verify_commit(CHAIN, BID, 5, commit)
+    vals.verify_commit_light(CHAIN, BID, 5, commit)
+
+
+@pytest.mark.parametrize("corrupt_type", ["ed25519", "bls12_381"])
+def test_mixed_commit_corrupted_row_in_each_arm(corrupt_type):
+    vals, ordered = mixed_valset()
+    corrupt_idx = next(
+        i for i, v in enumerate(vals.validators) if v.pub_key.type_name() == corrupt_type
+    )
+    commit = ed_commit(vals, ordered, corrupt_idx=corrupt_idx)
+    with pytest.raises(CommitVerifyError):
+        vals.verify_commit(CHAIN, BID, 5, commit)
+
+
+def test_mixed_valset_hash_covers_bls_keys():
+    vals, _ = mixed_valset()
+    assert len(vals.hash()) == 32  # simple_bytes handles bls12_381 keys
+
+
+def test_verify_batch_mixed_bls_rows():
+    ed = K.gen_ed25519(b"\x01" * 32)
+    bls = K.gen_bls12_381(b"\x61" * 32)
+    msgs = [b"m0", b"m1", b"m2", b"m3"]
+    pks = [ed.pub_key().bytes(), bls.pub_key().bytes(), bls.pub_key().bytes(), b"\x00" * 48]
+    sigs = [ed.sign(b"m0"), bls.sign(b"m1"), bls.sign(b"WRONG"), b"\x00" * 96]
+    mask = verify_batch(
+        pks, msgs, sigs, key_types=["ed25519", "bls12_381", "bls12_381", "bls12_381"]
+    )
+    assert mask.tolist() == [True, True, False, False]
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def test_backend_series_and_aggregate_size_gauge():
+    m = M.batch_metrics()
+
+    def val(metric, *labels):
+        return metric._values.get(tuple(labels), 0.0)
+
+    base_rows = val(m.backend_rows, "bls12_381")
+    base_fl = val(m.backend_flushes, "bls12_381")
+    vals, privs = bls_valset(5)
+    register_all(privs)
+    agg = make_agg(vals, privs, list(range(5)))
+    vals.verify_aggregate_commit(CHAIN, BID, 5, agg)
+    assert val(m.backend_rows, "bls12_381") == base_rows + 5
+    assert val(m.backend_flushes, "bls12_381") == base_fl + 1
+    assert val(m.aggregate_size) == 5
+    # ed25519 rows attributed on the plain path
+    base_ed = val(m.backend_rows, "ed25519")
+    ed = K.gen_ed25519(b"\x05" * 32)
+    verify_batch([ed.pub_key().bytes()] * 3, [b"x"] * 3, [ed.sign(b"x")] * 3)
+    assert val(m.backend_rows, "ed25519") == base_ed + 3
+
+
+def test_bls_rows_ride_scheduler_qos_lanes():
+    """BLS rows submitted inside a scheduler lane scope join the node-wide
+    combined flush like every other key type, verdicts unchanged."""
+    from tendermint_tpu.crypto import scheduler as S
+
+    ed = K.gen_ed25519(b"\x02" * 32)
+    bls = K.gen_bls12_381(b"\x62" * 32)
+    pks = [ed.pub_key().bytes(), bls.pub_key().bytes(), bls.pub_key().bytes()]
+    msgs = [b"l0", b"l1", b"l2"]
+    sigs = [ed.sign(b"l0"), bls.sign(b"l1"), bls.sign(b"BAD")]
+    kts = ["ed25519", "bls12_381", "bls12_381"]
+    expect = verify_batch(pks, msgs, sigs, "cpu", key_types=kts)
+    s = S.VerifyScheduler(backend="cpu")
+    try:
+        with s.lane_scope("catchup"):
+            got = verify_batch(pks, msgs, sigs, key_types=kts)
+        assert (got == expect).all() and got.tolist() == [True, True, False]
+        assert s.stats()["lanes"]["catchup"]["rows_total"] == 3
+    finally:
+        s.close()
+
+
+def test_prewarm_bls_is_flag_gated():
+    from tendermint_tpu.crypto import batch as batch_mod
+
+    called = []
+    orig = batch_mod._prewarm_bls
+    batch_mod._prewarm_bls = lambda: called.append(1)
+    try:
+        batch_mod.prewarm(4, backend="cpu", bls=False)
+        assert not called
+        batch_mod.prewarm(4, backend="cpu", bls=True)
+        assert called
+    finally:
+        batch_mod._prewarm_bls = orig
+
+
+def test_prewarm_bls_runs():
+    from tendermint_tpu.crypto.batch import _prewarm_bls
+
+    _prewarm_bls()  # must not raise; warms tables + MSM bucket
