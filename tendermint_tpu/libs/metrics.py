@@ -1008,6 +1008,32 @@ class ChaosMetrics:
         )
 
 
+class FleetMetrics:
+    """Fleet-soak referee accounting (tools/fleet_referee.py + chaos/fleet.py):
+    how many nodes each role contributed, how many cross-node safety
+    comparisons the referee ran, and which verdicts it handed down. Global
+    (not per-Node) because the referee sits OUTSIDE any single node — it
+    audits all of them."""
+
+    def __init__(self, reg: Registry):
+        self.nodes_by_role = reg.gauge(
+            f"{NAMESPACE}_fleet_nodes_by_role",
+            "Live fleet nodes per role (validator/full/light_edge).",
+            ("role",),
+        )
+        self.safety_checks = reg.counter(
+            f"{NAMESPACE}_fleet_safety_checks_total",
+            "Per-height cross-node block-hash comparisons run by the "
+            "fleet referee's safety auditor.",
+        )
+        self.referee_verdicts = reg.counter(
+            f"{NAMESPACE}_fleet_referee_verdicts_total",
+            "Fleet-referee verdicts handed down, by verdict "
+            "(pass/partial/slo_tripped/safety_violation/no_data).",
+            ("verdict",),
+        )
+
+
 # Process-global registry: series owned by process-global subsystems (the
 # crypto batch pipeline, the AOT kernel cache, pubsub overflow accounting)
 # rather than a Node instance.
@@ -1018,11 +1044,12 @@ _PUBSUB_METRICS: Optional[PubSubMetrics] = None
 _CHAOS_METRICS: Optional[ChaosMetrics] = None
 _MESH_METRICS: Optional[MeshMetrics] = None
 _OBSERVATORY_METRICS: Optional[ObservatoryMetrics] = None
+_FLEET_METRICS: Optional[FleetMetrics] = None
 
 
 def global_registry() -> Registry:
     global _GLOBAL_REGISTRY, _BATCH_METRICS, _PUBSUB_METRICS, _CHAOS_METRICS
-    global _MESH_METRICS, _OBSERVATORY_METRICS
+    global _MESH_METRICS, _OBSERVATORY_METRICS, _FLEET_METRICS
     with _GLOBAL_LOCK:
         if _GLOBAL_REGISTRY is None:
             _GLOBAL_REGISTRY = Registry()
@@ -1031,6 +1058,7 @@ def global_registry() -> Registry:
             _CHAOS_METRICS = ChaosMetrics(_GLOBAL_REGISTRY)
             _MESH_METRICS = MeshMetrics(_GLOBAL_REGISTRY)
             _OBSERVATORY_METRICS = ObservatoryMetrics(_GLOBAL_REGISTRY)
+            _FLEET_METRICS = FleetMetrics(_GLOBAL_REGISTRY)
         return _GLOBAL_REGISTRY
 
 
@@ -1057,6 +1085,11 @@ def mesh_metrics() -> MeshMetrics:
 def observatory_metrics() -> ObservatoryMetrics:
     global_registry()
     return _OBSERVATORY_METRICS
+
+
+def fleet_metrics() -> FleetMetrics:
+    global_registry()
+    return _FLEET_METRICS
 
 
 class NodeMetrics:

@@ -69,7 +69,7 @@ _WATERFALL_STAGES = (
 # -- capture ------------------------------------------------------------------
 
 
-def capture_node_dump(node) -> dict:
+def capture_node_dump(node, hash_window: int = 64) -> dict:
     """In-process capture of one node's observability surfaces (the offline
     producer soaks/bench use — no RPC listener needed). Every section
     degrades independently to an error string."""
@@ -131,7 +131,27 @@ def capture_node_dump(node) -> dict:
         doc["peers"] = peers
     except Exception as e:
         doc["peers"] = {"error": repr(e)}
+    try:
+        doc["chain"] = _chain_section(node, hash_window)
+    except Exception as e:
+        doc["chain"] = {"error": repr(e)}
     return doc
+
+
+def _chain_section(node, hash_window: int) -> dict:
+    """Committed block hashes over the last `hash_window` heights — the raw
+    material for the fleet referee's cross-node safety audit
+    (tools/fleet_referee.py). Bounded: a 100k-height chain contributes the
+    same few KB as a 100-height one."""
+    bs = node.block_store
+    top = bs.height
+    lo = max(bs.base or 1, 1, top - hash_window + 1)
+    hashes = {}
+    for h in range(lo, top + 1):
+        b = bs.load_block(h)
+        if b is not None:
+            hashes[str(h)] = b.hash().hex()
+    return {"base": bs.base, "height": top, "hashes": hashes}
 
 
 def write_node_dump(node, directory: str) -> str:
@@ -145,10 +165,13 @@ def write_node_dump(node, directory: str) -> str:
     return path
 
 
-async def scrape_node(base_url: str) -> dict:
+async def scrape_node(base_url: str, timeout: float = 5.0) -> dict:
     """Live capture of one node over its RPC listener. Each endpoint
     degrades independently (a node mid-overload still yields a partial
-    dump)."""
+    dump), and every call is bounded by `timeout` seconds — one hung node
+    in a 50-node fleet must cost at most a timeout, never the scrape."""
+    import asyncio
+
     from tendermint_tpu.rpc.client import HTTPClient
 
     client = HTTPClient(base_url)
@@ -160,18 +183,24 @@ async def scrape_node(base_url: str) -> dict:
 
     async def call(section, method, **params):
         try:
-            doc[section] = await client.call(method, **params)
+            doc[section] = await asyncio.wait_for(
+                client.call(method, **params), timeout
+            )
         except Exception as e:
             doc[section] = {"error": repr(e)}
 
     try:
         try:
-            st = await client.call("status")
+            st = await asyncio.wait_for(client.call("status"), timeout)
             doc["node_id"] = st.get("node_info", {}).get("id")
             doc["moniker"] = st.get("node_info", {}).get("moniker")
         except Exception as e:
+            # the identity call failing marks the WHOLE dump: the merge's
+            # coverage section must list this node as missing, not quietly
+            # fold an empty record in
             doc["node_id"] = None
             doc["error_status"] = repr(e)
+            doc["scrape_error"] = repr(e)
         await call("timeline", "consensus_timeline")
         await call("slo", "debug_slo")
         await call("verify_stats", "debug_verify_stats")
@@ -180,10 +209,36 @@ async def scrape_node(base_url: str) -> dict:
         await call("txtrace", "debug_tx_trace")
         tl = doc.get("timeline") or {}
         if doc.get("node_id") is None:
-            doc["node_id"] = tl.get("node_id")
+            doc["node_id"] = tl.get("node_id") if isinstance(tl, dict) else None
     finally:
         await client.close()
     return doc
+
+
+async def scrape_fleet(
+    urls: List[str], timeout: float = 5.0, concurrency: int = 16
+) -> List[dict]:
+    """Scrape many nodes concurrently (bounded by `concurrency`): a 50-node
+    fleet scrape costs ~ceil(50/16) round-trips, and a node that fails
+    entirely still yields a dump row carrying `scrape_error` so the report
+    can NAME it instead of dropping it."""
+    import asyncio
+
+    sem = asyncio.Semaphore(max(1, concurrency))
+
+    async def one(u: str) -> dict:
+        async with sem:
+            try:
+                return await scrape_node(u, timeout=timeout)
+            except Exception as e:
+                return {
+                    "observatory_dump": DUMP_VERSION,
+                    "node_id": u,
+                    "scraped_from": u,
+                    "scrape_error": repr(e),
+                }
+
+    return list(await asyncio.gather(*(one(u) for u in urls)))
 
 
 def load_dumps(directory: str) -> List[dict]:
@@ -193,7 +248,17 @@ def load_dumps(directory: str) -> List[dict]:
             with open(path) as f:
                 doc = json.load(f)
         except (OSError, ValueError) as e:
-            out.append({"node_id": os.path.basename(path), "load_error": f"{e!r}"})
+            # label the broken dump by its node stem (observatory_<id>.json
+            # -> <id>) so coverage lists name the NODE, not a truncated
+            # filename prefix shared by every dump in the directory
+            stem = os.path.splitext(os.path.basename(path))[0]
+            if stem.startswith(DUMP_PREFIX):
+                stem = stem[len(DUMP_PREFIX):] or stem
+            out.append({
+                "node_id": stem,
+                "load_error": f"{e!r}",
+                "source_file": path,
+            })
             continue
         doc.setdefault("source_file", path)
         out.append(doc)
@@ -267,14 +332,24 @@ def _ms(ts: Optional[float], t0: Optional[float]) -> Optional[float]:
 
 
 def merge(dumps: List[dict], max_heights: Optional[int] = None) -> dict:
-    """Merge per-node dumps into the chain report structure."""
+    """Merge per-node dumps into the chain report structure.
+
+    Fleet-scale contract (ISSUE 17): a dump that failed to load or scrape is
+    NEVER silently dropped — it keeps its node row and is named in the
+    report's `coverage.missing` list; and only the merge window's height
+    records are retained per node, so merging 100 deep dumps holds
+    O(nodes × window) milestone state, not O(nodes × chain length)."""
     nodes = []
     per_node_heights: Dict[str, Dict[int, dict]] = {}
+    missing: List[str] = []
     for dump in dumps:
         label = _node_label(dump)
-        recs = _height_records(dump)
+        failure = dump.get("load_error") or dump.get("scrape_error")
+        recs = {} if failure else _height_records(dump)
         per_node_heights[label] = recs
         slo = dump.get("slo") or {}
+        if failure:
+            missing.append(label)
         nodes.append(
             {
                 "node": label,
@@ -287,12 +362,20 @@ def merge(dumps: List[dict], max_heights: Optional[int] = None) -> dict:
                 "slo_enabled": bool(slo.get("enabled")),
                 "slo_any_tripped": bool(slo.get("any_tripped")),
                 "load_error": dump.get("load_error"),
+                "scrape_error": dump.get("scrape_error"),
             }
         )
 
     all_heights = sorted({h for recs in per_node_heights.values() for h in recs})
     if max_heights is not None and max_heights > 0:
         all_heights = all_heights[-max_heights:]
+    # bound the retained state to the merge window before milestone
+    # extraction — out-of-window records are released here
+    window = set(all_heights)
+    for label in per_node_heights:
+        per_node_heights[label] = {
+            h: rec for h, rec in per_node_heights[label].items() if h in window
+        }
 
     heights_out = []
     slow_counts: Dict[str, int] = {}
@@ -459,6 +542,12 @@ def merge(dumps: List[dict], max_heights: Optional[int] = None) -> dict:
     worst_offender = max(slow_counts.items(), key=lambda kv: kv[1])[0] if slow_counts else None
     return {
         "generated_ts": round(time.time(), 3),
+        "coverage": {
+            "expected": len(dumps),
+            "merged": len(dumps) - len(missing),
+            "missing": sorted(missing),
+            "partial": bool(missing),
+        },
         "nodes": nodes,
         "heights": heights_out,
         "peer_lag": peer_lag,
@@ -491,6 +580,13 @@ def render_markdown(report: dict) -> str:
         "Waterfall offsets are milliseconds from proposal creation (each "
         "node's LOCAL clock; propagation latencies inside are skew-corrected)."
     )
+    cov = report.get("coverage")
+    if cov and cov.get("partial"):
+        lines.append("")
+        lines.append(
+            f"**PARTIAL COVERAGE**: {cov['merged']}/{cov['expected']} dumps "
+            f"merged; missing: {', '.join(cov['missing'])}"
+        )
     lines.append("")
     lines.append("## Nodes")
     lines.append("")
@@ -632,16 +728,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--check", action="store_true",
         help="exit 2 when any node's SLO guard tripped",
     )
+    ap.add_argument(
+        "--timeout", type=float, default=5.0,
+        help="per-endpoint scrape timeout in seconds (default 5)",
+    )
+    ap.add_argument(
+        "--concurrency", type=int, default=16,
+        help="concurrent node scrapes (default 16)",
+    )
     args = ap.parse_args(argv)
 
     if args.nodes:
         import asyncio
 
-        async def scrape_all():
-            urls = [u.strip() for u in args.nodes.split(",") if u.strip()]
-            return await asyncio.gather(*(scrape_node(u) for u in urls))
-
-        dumps = list(asyncio.run(scrape_all()))
+        urls = [u.strip() for u in args.nodes.split(",") if u.strip()]
+        dumps = asyncio.run(
+            scrape_fleet(urls, timeout=args.timeout, concurrency=args.concurrency)
+        )
     else:
         dumps = load_dumps(args.dumps)
         if not dumps:

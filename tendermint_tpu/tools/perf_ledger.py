@@ -113,6 +113,8 @@ def parse_bench(path: str) -> dict:
         "fingerprint": None,
         "versions": None,
         "scenarios": {},
+        "fleet_gate": None,
+        "fleet_gate_missing": True,
     }
     if doc is None or "_load_error" in (doc or {}):
         row["lost"] = True
@@ -142,6 +144,18 @@ def parse_bench(path: str) -> dict:
             if host.get(k)
         }
     row["scenarios"] = _scenario_speedups(extra)
+    # fleet-gate column (ISSUE 17): rounds that ran the `fleet_soak`
+    # scenario carry the referee verdict + heights + safety-violation count;
+    # rounds that didn't are flagged like headline_missing — a silently
+    # skipped fleet gate must read as a gap, not a pass
+    fs = extra.get("fleet_soak")
+    if isinstance(fs, dict) and fs.get("verdict"):
+        row["fleet_gate"] = {
+            "verdict": fs.get("verdict"),
+            "heights": fs.get("heights"),
+            "violations": fs.get("safety_violations"),
+        }
+        row["fleet_gate_missing"] = False
     # a parsed round that carries NEITHER the headline metric nor a
     # headline scenario datapoint lost the trajectory point — flag it
     # explicitly instead of leaving a silent gap in the matrix
@@ -221,6 +235,9 @@ def load_ledger(root: str) -> dict:
         "headline_missing_rounds": [
             r["file"] for r in bench if r.get("headline_missing")
         ],
+        "fleet_gate_missing_rounds": [
+            r["file"] for r in bench if r.get("fleet_gate_missing")
+        ],
     }
 
 
@@ -233,11 +250,12 @@ def check_regressions(ledger: dict, tolerance: float = 0.25) -> List[str]:
         if not r["lost"] and not r.get("degraded")
         and isinstance(r.get("value"), (int, float))
     ]
-    if len(healthy) < 2:
-        return []
-    latest = healthy[-1]
-    prior = [r for r in healthy[:-1] if r["metric"] == latest["metric"]]
-    failures = []
+    failures: List[str] = []
+    latest = healthy[-1] if healthy else None
+    prior = (
+        [r for r in healthy[:-1] if r["metric"] == latest["metric"]]
+        if len(healthy) >= 2 else []
+    )
     if prior:
         best = min(prior, key=lambda r: r["value"])
         budget = best["value"] * (1.0 + tolerance)
@@ -248,6 +266,18 @@ def check_regressions(ledger: dict, tolerance: float = 0.25) -> List[str]:
                 f"{latest['file']} vs best {best['value']:.3f} in "
                 f"{best['file']} (budget {budget:.3f}, tolerance "
                 f"{tolerance:.0%})"
+            )
+    # fleet gate (ISSUE 17): the newest round that ran the fleet soak must
+    # have a passing referee verdict with zero safety violations
+    ran_fleet = [r for r in ledger["bench"] if r.get("fleet_gate")]
+    if ran_fleet:
+        latest_fg = ran_fleet[-1]
+        fg = latest_fg["fleet_gate"]
+        if fg.get("verdict") != "pass" or (fg.get("violations") or 0) > 0:
+            failures.append(
+                f"fleet gate failed in {latest_fg['file']}: "
+                f"verdict={fg.get('verdict')} heights={fg.get('heights')} "
+                f"violations={fg.get('violations')}"
             )
     return failures
 
@@ -273,8 +303,8 @@ def render_markdown(ledger: dict) -> str:
         "",
         "## Bench rounds",
         "",
-        "| round | metric | value | speedup | host | status |",
-        "|---:|---|---:|---:|---|---|",
+        "| round | metric | value | speedup | fleet gate | host | status |",
+        "|---:|---|---:|---:|---|---|---|",
     ]
     for r in ledger["bench"]:
         if r["lost"]:
@@ -299,12 +329,21 @@ def render_markdown(ledger: dict) -> str:
                 if isinstance(r["vs_baseline"], (int, float)) and r["vs_baseline"]
                 else "—"
             )
+        fg = r.get("fleet_gate")
+        if fg:
+            mark = "" if fg.get("verdict") == "pass" else "**"
+            fleet = (
+                f"{mark}{fg.get('verdict')}{mark}·{fg.get('heights') or '?'}h·"
+                f"{fg.get('violations') if fg.get('violations') is not None else '?'}v"
+            )
+        else:
+            fleet = "missing"
         host = r["fingerprint"] or "—"
         if r.get("versions"):
             host += f" ({_fmt_versions(r['versions'])})"
         lines.append(
             f"| {_round_label(r)} | {r['metric'] or '—'} | {value} "
-            f"| {speed} | {host} | {status} |"
+            f"| {speed} | {fleet} | {host} | {status} |"
         )
     lines += ["", "### Per-scenario speedups", ""]
     scen_names: List[str] = []

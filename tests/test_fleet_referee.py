@@ -1,0 +1,500 @@
+"""Fleet referee + release gate verdict engine (ISSUE 17), synthetic inputs.
+
+Tier-1 throughout and deliberately fleet-free: every test here drives the
+verdict engine on hand-built observatory dumps / manifests / BENCH round
+files, pinning the exit-code matrix WITHOUT spawning a single node:
+
+    referee:       pass 0 · no_data 1 · safety_violation 2 · slo_tripped 3
+                   · partial 4
+    release gate:  + perf_regression 5 · fleet_missing 6 · tier1_failed 7,
+                   severity-ordered (a fork outranks everything)
+
+The fleet soak tests that produce these inputs for real live in
+tests/test_fleet_soak.py."""
+
+import json
+import os
+
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.tools import chain_observatory as obs
+from tendermint_tpu.tools import fleet_referee as ref
+from tendermint_tpu.tools import perf_ledger
+from tendermint_tpu.tools import release_gate as gate
+
+T0 = 1_700_000_000.0
+
+
+# -- synthetic evidence builders ----------------------------------------------
+
+
+def make_dump(label, heights, *, tripped=False, fork_at=None, terminals=None):
+    """One synthetic observatory dump shaped like capture_node_dump's
+    output: a timeline (waterfall raw material), an SLO snapshot, tx
+    terminals, and the `chain` hash window the safety auditor reads."""
+    recs = []
+    hashes = {}
+    for h in range(1, heights + 1):
+        t = T0 + h
+        recs.append(
+            {
+                "height": h,
+                "proposals": [{"ts": t}],
+                "steps": [
+                    {"step": "PRECOMMIT", "ts": t + 0.05},
+                    {"step": "COMMIT", "ts": t + 0.08},
+                ],
+                "commit": {"ts": t + 0.1, "round": 0},
+                "propagation": {},
+            }
+        )
+        hx = f"{h:064x}"
+        if fork_at is not None and h == fork_at:
+            hx = "f" * 64  # this node committed a DIFFERENT block here
+        hashes[str(h)] = hx
+    return {
+        "observatory_dump": 1,
+        "node_id": label,
+        "moniker": label,
+        "timeline": {"heights": recs, "propagation_peers": {}},
+        "slo": {
+            "enabled": True,
+            "any_tripped": tripped,
+            "objectives": {
+                "consensus_commit_latency": {
+                    "verdict": "TRIPPED" if tripped else "ok",
+                    "tripped": tripped,
+                    "trips_total": 1 if tripped else 0,
+                    "breaches": 3 if tripped else 0,
+                    "observations": heights,
+                    "worst_s": 0.5,
+                    "burn_rate": {},
+                }
+            },
+        },
+        "txtrace": {"enabled": True, "terminals": terminals or {}},
+        "chain": {"base": 1, "height": heights, "hashes": hashes},
+    }
+
+
+def write_dumps(directory, dumps):
+    os.makedirs(directory, exist_ok=True)
+    for d in dumps:
+        path = os.path.join(directory, f"{obs.DUMP_PREFIX}{d['node_id']}.json")
+        with open(path, "w") as f:
+            json.dump(d, f)
+
+
+def write_manifest(directory, labels_roles, *, seed=7, live=None):
+    doc = {
+        "fleet_manifest": 1,
+        "seed": seed,
+        "fingerprint": "feedfacefeedface",
+        "schedule_fingerprint": "deadbeefdeadbeef",
+        "nodes": [
+            {
+                "index": i,
+                "label": lbl,
+                "role": role,
+                "live": (lbl in live) if live is not None else True,
+            }
+            for i, (lbl, role) in enumerate(labels_roles)
+        ],
+    }
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, ref.MANIFEST_NAME), "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+# -- the safety auditor --------------------------------------------------------
+
+
+def test_safety_audit_clean():
+    dumps = [make_dump(f"n{i}", 8) for i in range(4)]
+    audit = ref.safety_audit(dumps)
+    assert audit["nodes_audited"] == 4
+    assert audit["heights_checked"] == 8
+    assert audit["violations"] == []
+
+
+def test_safety_audit_names_the_forked_height():
+    dumps = [make_dump("good0", 8), make_dump("good1", 8),
+             make_dump("evil", 8, fork_at=7)]
+    audit = ref.safety_audit(dumps)
+    assert len(audit["violations"]) == 1
+    viol = audit["violations"][0]
+    assert viol["height"] == 7
+    assert viol["hashes"]["evil"] == "f" * 64
+    assert viol["hashes"]["good0"] == f"{7:064x}"
+
+
+def test_safety_audit_ignores_unshared_heights():
+    # a node that is ahead of everyone is NOT a violation — only heights
+    # two or more nodes share are comparable
+    dumps = [make_dump("a", 4), make_dump("b", 9, fork_at=9)]
+    audit = ref.safety_audit(dumps)
+    assert audit["heights_checked"] == 4
+    assert audit["violations"] == []
+
+
+# -- verdicts + exit codes -----------------------------------------------------
+
+
+def test_exit_code_matrix_is_pinned():
+    assert ref.EXIT_CODES == {
+        "pass": 0,
+        "no_data": 1,
+        "safety_violation": 2,
+        "slo_tripped": 3,
+        "partial": 4,
+    }
+    assert (gate.EXIT_PASS, gate.EXIT_SAFETY, gate.EXIT_SLO,
+            gate.EXIT_PARTIAL, gate.EXIT_PERF, gate.EXIT_FLEET_MISSING,
+            gate.EXIT_TIER1) == (0, 2, 3, 4, 5, 6, 7)
+    # severity: worst first, fork on top
+    assert gate.SEVERITY == (2, 3, 4, 5, 6, 7)
+
+
+def test_verdict_pass():
+    report = ref.build_report([make_dump(f"n{i}", 6) for i in range(3)])
+    assert report["verdict"] == "pass"
+    assert report["exit_code"] == 0
+    assert report["safety"]["violations"] == []
+    assert not report["coverage"]["partial"]
+
+
+def test_verdict_no_data():
+    report = ref.build_report([])
+    assert report["verdict"] == "no_data"
+    assert report["exit_code"] == 1
+
+
+def test_verdict_slo_tripped():
+    dumps = [make_dump("ok0", 6), make_dump("burny", 6, tripped=True)]
+    report = ref.build_report(dumps)
+    assert report["verdict"] == "slo_tripped"
+    assert report["exit_code"] == 3
+
+
+def test_safety_outranks_slo_and_partial():
+    # a fork + a tripped SLO + a corrupt dump: the fork names the verdict
+    dumps = [make_dump("good", 8, tripped=True),
+             make_dump("evil", 8, fork_at=3),
+             {"node_id": "corrupt", "load_error": "ValueError('bad json')"}]
+    report = ref.build_report(dumps)
+    assert report["verdict"] == "safety_violation"
+    assert report["exit_code"] == 2
+    assert report["safety"]["violations"][0]["height"] == 3
+    # the lesser findings are still reported, not masked
+    assert report["slo_any_tripped"] is True
+    assert report["coverage"]["partial"] is True
+
+
+def test_waterfall_covers_every_node():
+    dumps = [make_dump(f"n{i}", 5) for i in range(4)]
+    report = ref.build_report(dumps)
+    wf = report["waterfall"]
+    assert wf["heights_merged"] == 5
+    assert set(wf["per_node"]) == {"n0", "n1", "n2", "n3"}
+    assert all(c == 5 for c in wf["per_node"].values())
+    assert wf["uncovered"] == []
+
+
+def test_terminals_fold_fleet_wide():
+    dumps = [
+        make_dump("a", 4, terminals={"delivered": 5, "rejected": 1}),
+        make_dump("b", 4, terminals={"delivered": 7}),
+    ]
+    report = ref.build_report(dumps)
+    assert report["terminals"] == {"delivered": 12, "rejected": 1}
+
+
+# -- coverage: corrupt dumps and manifest ghosts -------------------------------
+
+
+def test_corrupt_dump_is_partial_not_a_crash(tmp_path):
+    d = str(tmp_path)
+    write_dumps(d, [make_dump("n0", 6), make_dump("n1", 6)])
+    with open(os.path.join(d, f"{obs.DUMP_PREFIX}corrupt.json"), "w") as f:
+        f.write("{not json at all")
+    rc = ref.main(["--dumps", d, "--check"])
+    assert rc == 4
+    with open(os.path.join(d, "fleet_report.json")) as f:
+        report = json.load(f)
+    assert report["verdict"] == "partial"
+    assert any("corrupt" in m for m in report["coverage"]["missing"])
+    assert any("corrupt" in m for m in report["coverage"]["failed_dumps"])
+    # the healthy nodes still merged
+    assert report["coverage"]["merged"] == 2
+
+
+def test_manifest_names_nodes_that_never_dumped():
+    manifest = {
+        "fleet_manifest": 1,
+        "seed": 1,
+        "nodes": [
+            {"label": "n0", "role": "validator", "live": True},
+            {"label": "ghost", "role": "full", "live": True},
+            {"label": "dead", "role": "full", "live": False},
+        ],
+    }
+    report = ref.build_report([make_dump("n0", 5)], manifest=manifest)
+    assert report["verdict"] == "partial"
+    assert report["coverage"]["never_dumped"] == ["ghost"]
+    # a node the harness knows DIED is not expected to dump
+    assert "dead" not in report["coverage"]["missing"]
+    assert report["coverage"]["expected_live"] == 2
+
+
+def test_role_slo_fold(tmp_path):
+    d = str(tmp_path)
+    dumps = [make_dump("val0", 6), make_dump("val1", 6, tripped=True),
+             make_dump("edge0", 6)]
+    write_dumps(d, dumps)
+    write_manifest(d, [("val0", "validator"), ("val1", "validator"),
+                       ("edge0", "light_edge")])
+    report = ref.build_report(obs.load_dumps(d), manifest=ref.load_manifest(d))
+    rs = report["role_slo"]
+    assert rs["validator"]["nodes"] == 2
+    assert rs["validator"]["tripped"] == 1
+    assert rs["validator"]["verdict"] == "TRIPPED"
+    assert rs["light_edge"]["verdict"] == "ok"
+    assert report["roles"]["edge0"] == "light_edge"
+    assert report["manifest"]["schedule_fingerprint"] == "deadbeefdeadbeef"
+
+
+# -- CLI + markdown ------------------------------------------------------------
+
+
+def test_cli_fork_exits_2_and_markdown_names_the_height(tmp_path, capsys):
+    d = str(tmp_path)
+    write_dumps(d, [make_dump("good0", 8), make_dump("good1", 8),
+                    make_dump("evil", 8, fork_at=7)])
+    rc = ref.main(["--dumps", d, "--check"])
+    assert rc == 2
+    out = capsys.readouterr().out
+    assert "SAFETY VIOLATION at height 7" in out
+    md = open(os.path.join(d, "fleet_report.md")).read()
+    assert "SAFETY VIOLATION at height 7" in md
+    assert "evil" in md
+
+
+def test_cli_pass_exits_0_and_without_check_always_0(tmp_path):
+    d = str(tmp_path)
+    write_dumps(d, [make_dump("n0", 5), make_dump("n1", 5)])
+    assert ref.main(["--dumps", d, "--check"]) == 0
+    write_dumps(d, [make_dump("evil", 5, fork_at=2)])
+    # without --check the CLI reports but exits 0 (report-only mode)
+    assert ref.main(["--dumps", d]) == 0
+
+
+def test_cli_empty_dir_is_no_data(tmp_path):
+    assert ref.main(["--dumps", str(tmp_path), "--check"]) == 1
+
+
+# -- release gate composition --------------------------------------------------
+
+
+def _bench_round(path, value, *, fleet=None, extra=None):
+    blob = {"metric": "verify_commit_10k_latency", "value": value,
+            "unit": "ms", "extra": dict(extra or {})}
+    blob["extra"]["verify_commit_10k"] = {"speedup_e2e": 1.0}
+    if fleet is not None:
+        blob["extra"]["fleet_soak"] = fleet
+    with open(path, "w") as f:
+        json.dump({"n": 1, "cmd": "bench", "rc": 0, "parsed": blob}, f)
+
+
+def test_release_gate_all_pass(tmp_path):
+    d = os.path.join(str(tmp_path), "obs")
+    write_dumps(d, [make_dump("n0", 5), make_dump("n1", 5)])
+    result = gate.evaluate(fleet_dumps=d, perf_root=str(tmp_path))
+    assert result["exit_code"] == 0
+    assert result["verdict"] == "pass"
+    assert result["gates"]["fleet"]["status"] == "pass"
+    # empty perf ledger is a pass (young repo), not a failure
+    assert result["gates"]["perf"]["status"] == "no_rounds"
+    assert result["gates"]["tier1"]["status"] == "skipped"
+    # the gate wrote the referee report next to the dumps
+    assert os.path.exists(os.path.join(d, "fleet_report.json"))
+
+
+def test_release_gate_safety_violation(tmp_path):
+    d = os.path.join(str(tmp_path), "obs")
+    write_dumps(d, [make_dump("good", 6), make_dump("evil", 6, fork_at=4)])
+    result = gate.evaluate(fleet_dumps=d, perf_root=str(tmp_path))
+    assert result["exit_code"] == 2
+    assert result["gates"]["fleet"]["detail"]["safety_violations"] == [4]
+
+
+def test_release_gate_fleet_missing(tmp_path):
+    # no dumps directory at all
+    result = gate.evaluate(
+        fleet_dumps=os.path.join(str(tmp_path), "nope"),
+        perf_root=str(tmp_path),
+    )
+    assert result["exit_code"] == 6
+    # an empty directory is equally missing evidence
+    empty = os.path.join(str(tmp_path), "empty")
+    os.makedirs(empty)
+    result = gate.evaluate(fleet_dumps=empty, perf_root=str(tmp_path))
+    assert result["exit_code"] == 6
+    # ... but explicitly skipping the fleet gate is recorded, not failed
+    result = gate.evaluate(skip_fleet=True, perf_root=str(tmp_path))
+    assert result["exit_code"] == 0
+    assert result["gates"]["fleet"]["status"] == "skipped"
+
+
+def test_release_gate_perf_regression(tmp_path):
+    root = str(tmp_path)
+    _bench_round(os.path.join(root, "BENCH_r01.json"), 100.0)
+    _bench_round(os.path.join(root, "BENCH_r02.json"), 200.0)  # 2x slower
+    result = gate.evaluate(skip_fleet=True, perf_root=root, tolerance=0.25)
+    assert result["exit_code"] == 5
+    assert result["gates"]["perf"]["status"] == "regression"
+    assert any("headline regression" in f
+               for f in result["gates"]["perf"]["detail"])
+
+
+def test_release_gate_fleet_gate_column_regression(tmp_path):
+    # a failing referee verdict recorded in the newest BENCH round trips
+    # the perf gate even when the live fleet gate is skipped
+    root = str(tmp_path)
+    _bench_round(os.path.join(root, "BENCH_r01.json"), 100.0,
+                 fleet={"verdict": "pass", "heights": 20,
+                        "safety_violations": 0})
+    _bench_round(os.path.join(root, "BENCH_r02.json"), 101.0,
+                 fleet={"verdict": "safety_violation", "heights": 21,
+                        "safety_violations": 1})
+    result = gate.evaluate(skip_fleet=True, perf_root=root)
+    assert result["exit_code"] == 5
+    assert any("fleet gate failed" in f
+               for f in result["gates"]["perf"]["detail"])
+
+
+def test_release_gate_tier1_failed(tmp_path):
+    result = gate.evaluate(skip_fleet=True, perf_root=str(tmp_path),
+                           tier1_cmd="exit 3")
+    assert result["exit_code"] == 7
+    assert result["gates"]["tier1"]["detail"]["rc"] == 3
+    result = gate.evaluate(skip_fleet=True, perf_root=str(tmp_path),
+                           tier1_cmd="true")
+    assert result["exit_code"] == 0
+
+
+def test_release_gate_severity_order(tmp_path):
+    # fork in the fleet AND a perf regression: the fork (2) wins
+    d = os.path.join(str(tmp_path), "obs")
+    write_dumps(d, [make_dump("good", 6), make_dump("evil", 6, fork_at=2)])
+    root = str(tmp_path)
+    _bench_round(os.path.join(root, "BENCH_r01.json"), 100.0)
+    _bench_round(os.path.join(root, "BENCH_r02.json"), 500.0)
+    result = gate.evaluate(fleet_dumps=d, perf_root=root)
+    assert result["gates"]["fleet"]["exit_code"] == 2
+    assert result["gates"]["perf"]["exit_code"] == 5
+    assert result["exit_code"] == 2
+
+
+def test_release_gate_cli(tmp_path):
+    d = os.path.join(str(tmp_path), "obs")
+    write_dumps(d, [make_dump("n0", 5), make_dump("n1", 5)])
+    out = os.path.join(str(tmp_path), "gate.json")
+    rc = gate.main(["--fleet-dumps", d, "--root", str(tmp_path),
+                    "--out", out, "--check"])
+    assert rc == 0
+    with open(out) as f:
+        summary = json.load(f)
+    assert summary["release_gate"] == 1
+    assert summary["verdict"] == "pass"
+    # fork through the CLI path
+    write_dumps(d, [make_dump("evil", 5, fork_at=3)])
+    rc = gate.main(["--fleet-dumps", d, "--root", str(tmp_path), "--check"])
+    assert rc == 2
+
+
+# -- perf ledger fleet-gate column ---------------------------------------------
+
+
+def test_perf_ledger_fleet_gate_column(tmp_path):
+    root = str(tmp_path)
+    _bench_round(os.path.join(root, "BENCH_r01.json"), 100.0)  # no fleet run
+    _bench_round(os.path.join(root, "BENCH_r02.json"), 99.0,
+                 fleet={"verdict": "pass", "heights": 21,
+                        "safety_violations": 0})
+    ledger = perf_ledger.load_ledger(root)
+    r1, r2 = ledger["bench"]
+    assert r1["fleet_gate"] is None and r1["fleet_gate_missing"]
+    assert r2["fleet_gate"] == {"verdict": "pass", "heights": 21,
+                                "violations": 0}
+    assert not r2["fleet_gate_missing"]
+    assert ledger["fleet_gate_missing_rounds"] == ["BENCH_r01.json"]
+    assert perf_ledger.check_regressions(ledger) == []
+    md = perf_ledger.render_markdown(ledger)
+    assert "fleet gate" in md          # the column exists
+    assert "pass·21h·0v" in md         # the round that ran it
+    assert "missing" in md             # the round that did not
+
+
+def test_perf_ledger_fleet_gate_failure_blocks_check(tmp_path):
+    root = str(tmp_path)
+    _bench_round(os.path.join(root, "BENCH_r01.json"), 100.0,
+                 fleet={"verdict": "slo_tripped", "heights": 20,
+                        "safety_violations": 0})
+    ledger = perf_ledger.load_ledger(root)
+    failures = perf_ledger.check_regressions(ledger)
+    assert len(failures) == 1
+    assert "fleet gate failed" in failures[0]
+    assert "slo_tripped" in failures[0]
+    assert perf_ledger.main(["--root", root, "--check"]) == 2
+
+
+# -- observatory fleet hardening -----------------------------------------------
+
+
+def test_merge_marks_partial_coverage_explicitly():
+    dumps = [make_dump("n0", 5),
+             {"node_id": "broke", "load_error": "OSError('gone')"}]
+    merged = obs.merge(dumps)
+    cov = merged["coverage"]
+    assert cov == {"expected": 2, "merged": 1, "missing": ["broke"],
+                   "partial": True}
+    # the failed node keeps a row naming its error
+    rows = {n["node"]: n for n in merged["nodes"]}
+    assert rows["broke"]["load_error"] == "OSError('gone')"
+    md = obs.render_markdown(merged)
+    assert "PARTIAL COVERAGE" in md
+    assert "broke" in md
+
+
+def test_merge_full_coverage_is_not_partial():
+    merged = obs.merge([make_dump("n0", 5), make_dump("n1", 5)])
+    assert merged["coverage"]["partial"] is False
+    assert merged["coverage"]["missing"] == []
+
+
+def test_merge_window_bounds_retained_heights():
+    # 100 deep dumps merged with a 5-height window keep only window records
+    dumps = [make_dump(f"n{i}", 100) for i in range(3)]
+    merged = obs.merge(dumps, max_heights=5)
+    assert len(merged["heights"]) == 5
+    assert merged["heights"][0]["height"] == 96
+    for n in merged["nodes"]:
+        assert n["heights"] == 100  # reported depth is pre-window
+
+
+def test_scrape_fleet_names_unreachable_nodes():
+    import asyncio
+
+    # nothing listens on these ports: every scrape must come back as a
+    # named scrape_error row, never an exception or a dropped node
+    urls = ["http://127.0.0.1:1", "http://127.0.0.1:2"]
+    dumps = asyncio.run(obs.scrape_fleet(urls, timeout=2.0, concurrency=2))
+    assert len(dumps) == 2
+    for d in dumps:
+        assert d.get("scrape_error")
+    merged = obs.merge(dumps)
+    assert merged["coverage"]["partial"] is True
+    assert len(merged["coverage"]["missing"]) == 2

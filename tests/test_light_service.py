@@ -236,6 +236,25 @@ def test_coalescing_respects_window_capacity():
     blocks = lt.make_chain(H + 1)
     svc = make_service(blocks, max_heights_per_flush=W)
 
+    # the coalescer's contract is same-tick submits join one batch, but
+    # each request reaches submit through an executor hop
+    # (validate_basic), so a gather burst can straddle loop ticks and
+    # split a window — hold every job at the submit boundary until the
+    # whole burst has arrived, making "a concurrent burst of H" literal
+    orig_submit = svc.coalescer.submit
+    gate = asyncio.Event()
+    arrived = 0
+
+    async def gated_submit(job):
+        nonlocal arrived
+        arrived += 1
+        if arrived == H:
+            gate.set()
+        await gate.wait()
+        return await orig_submit(job)
+
+    svc.coalescer.submit = gated_submit
+
     async def go():
         await svc._ensure_anchor()
         f0 = total_flushes()

@@ -192,6 +192,10 @@ METRICS_SETS = (
     # fed by crypto/scheduler.py (per-lane depth, queue waits, rows per
     # combined flush, vote-lane preemptions)
     M.SchedulerMetrics,
+    # fleet referee (ISSUE 17): tendermint_fleet_* fed by chaos/fleet.py
+    # (nodes per role) and tools/fleet_referee.py (safety-audit comparisons,
+    # verdicts handed down)
+    M.FleetMetrics,
 )
 
 

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Standalone runner for the fleet referee (ISSUE 17 verdict engine).
+
+Audits a fleet soak's observatory dumps offline — cross-node block-hash
+safety, per-role SLO verdicts, waterfall coverage, terminal accounting —
+and emits fleet_report.{json,md} plus a pinned exit code (0 pass, 2 safety
+violation, 3 SLO tripped, 4 partial coverage, 1 no data). Implementation:
+tendermint_tpu/tools/fleet_referee.py. Usage:
+
+    python tools/fleet_referee.py --dumps ./observatory --check
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from tendermint_tpu.tools.fleet_referee import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

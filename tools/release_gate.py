@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Standalone runner for the release gate (ISSUE 17).
+
+One entrypoint composing the fleet referee, the perf ledger's --check, and
+optionally the tier-1 suite into a single severity-ordered exit code
+(0 pass, 2 safety, 3 SLO, 4 partial coverage, 5 perf regression, 6 fleet
+evidence missing, 7 tier-1 failed). Implementation:
+tendermint_tpu/tools/release_gate.py. Usage:
+
+    python tools/release_gate.py --fleet-dumps ./observatory --root . --check
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from tendermint_tpu.tools.release_gate import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
