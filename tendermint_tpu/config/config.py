@@ -404,6 +404,29 @@ class CryptoConfig:
     # instead of compiling an unbounded one-off shape. Lanes = 2*rows + 1;
     # the default matches the 10k-commit steady-state bucket.
     max_flush_lanes: int = 24576
+    # Stage-overlapped host prep (crypto/batch.py, ISSUE 18).
+    # prep_threads: native prep worker-pool width for challenge hashing /
+    # scalar derivation / window sort (0 = host default, min(cores, 8)).
+    prep_threads: int = 0
+    # prep_staged: stage _rlc_submit's host prep (hashing on the prep pool
+    # while lane assembly + the A-block upload proceed; only the MSM gather
+    # waits on the window sort).
+    prep_staged: bool = True
+    # prep_stream: let IN-budget flushes of >= prep_stream_floor rows ride
+    # the flush planner as a 2-chunk stream (tail prep hides behind head
+    # kernels; reuses the planner's warm chunk bucket, no new compiles).
+    prep_stream: bool = True
+    prep_stream_floor: int = 2048
+    # prep_host_stripe: stripe the HOST (no-device) RLC fallback so the
+    # next stripe's prep overlaps the current Pippenger MSM. "auto" stripes
+    # only on multi-core hosts — on one core the overlap is time-slicing
+    # and the MSM split costs wall (cross-stripe per-signer coefficient
+    # collapse is lost). "1"/"0" force it on/off.
+    prep_host_stripe: str = "auto"
+    # Cross-flush verified-row memo (bounded LRU of digests of rows that
+    # verified OK; a commit assembled from deferred-verified votes flushes
+    # only the unseen residue). 0 disables.
+    verified_memo_rows: int = 65536
 
 
 @dataclass

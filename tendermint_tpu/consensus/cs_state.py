@@ -1096,7 +1096,14 @@ class ConsensusState:
         under a vote storm each flush is ONE batched kernel invocation over
         the validator axis instead of per-vote scalar verifies (the
         vectorized analog of the reference's per-vote path,
-        types/vote_set.go:143,203)."""
+        types/vote_set.go:143,203).
+
+        Rows that verify OK here also land in the cross-flush verified-row
+        memo (crypto/batch.VerifiedRowMemo): when this height commits, the
+        seen-commit's verify_commit re-presents the same (pubkey, msg, sig)
+        tuples and resolves them from the memo instead of re-flushing, so
+        the commit path only pays device time for signatures that were never
+        deferred-verified in the first place."""
         rs = self.rs
         if rs.votes is not None and rs.votes.has_pending():
             tr = _tracer if _tracer.enabled else None

@@ -725,6 +725,13 @@ class VerifyScheduler:
                 "lanes": lanes,
             }
         out["lane_wait_percentiles"] = self.wait_stats.percentiles()
+        # cross-flush verified-row memo (crypto/batch.py ISSUE 18): every
+        # lane consults it before joining the combined flush, so light
+        # serving and blocksync catch-up reuse each other's verdicts — the
+        # hit/eviction counters belong on the same debug surface
+        from tendermint_tpu.crypto import batch as _batch
+
+        out["verified_memo"] = _batch.verified_memo_stats()
         return out
 
     def close(self) -> None:

@@ -726,6 +726,19 @@ class BatchVerifyMetrics:
             "Host-prep seconds overlapped with device execution by the "
             "streamed planner's double buffer.",
         )
+        # stage-overlapped prep + verified-row memo (crypto/batch.py ISSUE 18)
+        self.prep_hidden_ratio = reg.gauge(
+            f"{ns}_prep_hidden_ratio",
+            "Fraction of the last flush's host-prep wall hidden behind "
+            "device/MSM execution (prep_overlap_s / prep_s; streamed, "
+            "pipelined and striped host-RLC flushes all feed it).",
+        )
+        self.memo_hits = reg.counter(
+            f"{ns}_memo_hits_total",
+            "Rows answered from the cross-flush verified-row memo without "
+            "re-verification (deferred-verified commit rows, light/catch-up "
+            "re-verifies).",
+        )
         self.compile_seconds = reg.counter(
             f"{ns}_compile_seconds_total",
             "Seconds spent tracing/exporting (export) or loading (deserialize) kernels.",

@@ -217,6 +217,8 @@ def record_flush(
     chunks: Optional[int] = None,
     chunk_lanes: Optional[int] = None,
     prep_overlap_s: Optional[float] = None,
+    prep_stages: Optional[dict] = None,
+    memo_hits: Optional[int] = None,
     tracer_: Optional[Tracer] = None,
 ) -> None:
     """One batch-verify flush completed. Called by crypto/batch.verify_batch
@@ -256,6 +258,11 @@ def record_flush(
         m.chunks_per_flush.observe(chunks)
     if prep_overlap_s:
         m.prep_overlap_seconds.inc(prep_overlap_s)
+    # ISSUE 18: hidden-prep fraction of THIS flush (streamed, pipelined and
+    # striped host-RLC paths all report prep_overlap_s now). memo_hits rides
+    # only the last-flush dict — VerifiedRowMemo.lookup owns the counter.
+    if prep_s and prep_overlap_s is not None:
+        m.prep_hidden_ratio.set(min(1.0, prep_overlap_s / prep_s))
 
     last = {
         "backend": backend,
@@ -294,6 +301,13 @@ def record_flush(
         last["chunk_lanes"] = chunk_lanes
     if prep_overlap_s is not None:
         last["prep_overlap_ms"] = round(prep_overlap_s * 1e3, 4)
+    if prep_stages:
+        last["prep_stages_ms"] = {
+            k[:-2] if k.endswith("_s") else k: round(v * 1e3, 4)
+            for k, v in prep_stages.items()
+        }
+    if memo_hits is not None:
+        last["memo_hits"] = memo_hits
     with _STATS_LOCK:
         t = _TOTALS.setdefault(
             (backend, path), {"flushes": 0, "sigs": 0, "seconds": 0.0}

@@ -29,7 +29,45 @@ _LIB = None
 _TRIED = False
 
 _BASE = os.path.dirname(os.path.abspath(__file__))
-_NTHREADS = min(8, os.cpu_count() or 1)
+
+
+def _default_threads() -> int:
+    """`[crypto] prep_threads` default: min(cores, 8), env-overridable
+    (TMTPU_PREP_THREADS) for differential tests that pin a thread count
+    regardless of the host (ISSUE 18)."""
+    env = os.environ.get("TMTPU_PREP_THREADS", "")
+    if env:
+        try:
+            return max(1, min(64, int(env)))
+        except ValueError:
+            pass
+    return min(8, os.cpu_count() or 1)
+
+
+_NTHREADS = _default_threads()
+
+
+def prep_threads() -> int:
+    """The thread count every native driver currently runs with."""
+    return _NTHREADS
+
+
+def configure_prep_threads(n: "int | None") -> int:
+    """Set the prep thread count (None/0 = host default) and resize the
+    persistent in-library worker pool to match. Safe before the library
+    is built: the pool is (re)spun on first successful load too."""
+    global _NTHREADS
+    _NTHREADS = _default_threads() if not n else max(1, min(64, int(n)))
+    lib = _lib()
+    if lib is not None:
+        lib.tm_prep_pool_configure(_NTHREADS)
+    return _NTHREADS
+
+
+def prep_pool_size() -> int:
+    """Live size of the native worker pool (1 = serial/per-call path)."""
+    lib = _lib()
+    return int(lib.tm_prep_pool_size()) if lib is not None else 1
 
 
 def _build() -> "ctypes.CDLL | None":
@@ -92,6 +130,15 @@ def _build() -> "ctypes.CDLL | None":
     lib.tm_sr25519_verify_one.argtypes = [u8p, u8p, ctypes.c_int64, u8p]
     lib.tm_sr25519_verify_one.restype = ctypes.c_int
     lib.tm_sr25519_verify_batch.argtypes = [u8p, u8p, i64p, u8p, ctypes.c_int64, u8p, ctypes.c_int]
+    lib.tm_prep_pool_configure.argtypes = [ctypes.c_int]
+    lib.tm_prep_pool_configure.restype = ctypes.c_int
+    lib.tm_prep_pool_size.argtypes = []
+    lib.tm_prep_pool_size.restype = ctypes.c_int
+    # park the worker pool at the configured width so the first flush
+    # never pays pthread_create (drivers fall back to per-call threads
+    # whenever the pool is busy or n == 1 thread is wanted)
+    if _NTHREADS > 1:
+        lib.tm_prep_pool_configure(_NTHREADS)
     return lib
 
 

@@ -260,6 +260,146 @@ static void store_le(const uint64_t *limbs, int nlimbs, uint8_t *p, int nbytes) 
 }
 
 /* ------------------------------------------------------------------ */
+/* Persistent prep pool (ISSUE 18)                                     */
+/*
+ * Per-call pthread_create/join costs ~50-100 us per thread — noise on a
+ * 10k-row hash pass but real on the striped pipeline where prep runs as
+ * many small slices per flush. The pool keeps `target - 1` workers parked
+ * on a condvar; a driver hands them the SAME job array the per-call path
+ * would have spawned threads for, so outputs are byte-identical by
+ * construction (jobs are fixed row/window slices; the only cross-thread
+ * accumulation, the scalar acc, is summed in job order by the caller).
+ *
+ * pool_run is non-reentrant by design: a second concurrent driver call
+ * (the Python prep pool hashing while the dispatch thread sorts) sees the
+ * pool busy and falls back to per-call threads. Nothing blocks, nothing
+ * wedges.
+ */
+
+typedef void *(*pool_fn)(void *);
+
+static pthread_mutex_t pool_mu = PTHREAD_MUTEX_INITIALIZER;
+static pthread_cond_t pool_go = PTHREAD_COND_INITIALIZER;
+static pthread_cond_t pool_done = PTHREAD_COND_INITIALIZER;
+static pthread_t pool_tids[64];
+static int pool_workers = 0; /* spawned workers; pool size = this + 1 */
+static int pool_shutdown = 0;
+static uint64_t pool_gen = 0;
+static pool_fn pool_job_fn = 0;
+static char *pool_jobs = 0;
+static size_t pool_job_size = 0;
+static int pool_njobs = 0;
+static int pool_next = 0;
+static int pool_remaining = 0;
+
+/* claim-and-run loop shared by workers and the submitting caller;
+ * pool_mu held on entry and exit. */
+static void pool_drain(pool_fn fn, char *jobs, size_t job_size) {
+  while (pool_next < pool_njobs) {
+    int idx = pool_next++;
+    pthread_mutex_unlock(&pool_mu);
+    fn(jobs + (size_t)idx * job_size);
+    pthread_mutex_lock(&pool_mu);
+    if (--pool_remaining == 0) pthread_cond_broadcast(&pool_done);
+  }
+}
+
+static void *pool_worker_main(void *arg) {
+  (void)arg;
+  uint64_t seen = 0;
+  pthread_mutex_lock(&pool_mu);
+  for (;;) {
+    while (!pool_shutdown && pool_gen == seen)
+      pthread_cond_wait(&pool_go, &pool_mu);
+    if (pool_shutdown) break;
+    seen = pool_gen;
+    pool_drain(pool_job_fn, pool_jobs, pool_job_size);
+  }
+  pthread_mutex_unlock(&pool_mu);
+  return 0;
+}
+
+/* Run njobs jobs on the pool (caller participates). Returns 1 when the
+ * pool ran them, 0 when the pool is absent/busy (caller must fall back
+ * to per-call threads). */
+static int pool_run(pool_fn fn, void *jobs, size_t job_size, int njobs) {
+  pthread_mutex_lock(&pool_mu);
+  if (pool_workers == 0 || pool_job_fn != 0) {
+    pthread_mutex_unlock(&pool_mu);
+    return 0;
+  }
+  pool_job_fn = fn;
+  pool_jobs = (char *)jobs;
+  pool_job_size = job_size;
+  pool_njobs = njobs;
+  pool_next = 0;
+  pool_remaining = njobs;
+  pool_gen++;
+  pthread_cond_broadcast(&pool_go);
+  pool_drain(fn, (char *)jobs, job_size);
+  while (pool_remaining > 0) pthread_cond_wait(&pool_done, &pool_mu);
+  pool_job_fn = 0;
+  pthread_mutex_unlock(&pool_mu);
+  return 1;
+}
+
+/* (Re)size the pool to `nthreads` total participants (caller included):
+ * spawns nthreads-1 parked workers. nthreads <= 1 tears the pool down
+ * (drivers go back to per-call threads / inline serial). Returns the
+ * effective pool size, or -1 when a resize raced a running job. */
+int tm_prep_pool_configure(int nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > 64) nthreads = 64;
+  int want = nthreads - 1;
+  pthread_mutex_lock(&pool_mu);
+  if (pool_job_fn != 0) {
+    pthread_mutex_unlock(&pool_mu);
+    return -1;
+  }
+  if (want == pool_workers) {
+    pthread_mutex_unlock(&pool_mu);
+    return pool_workers + 1;
+  }
+  if (pool_workers > 0) {
+    int old = pool_workers;
+    pool_shutdown = 1;
+    pthread_cond_broadcast(&pool_go);
+    pthread_mutex_unlock(&pool_mu);
+    for (int t = 0; t < old; t++) pthread_join(pool_tids[t], 0);
+    pthread_mutex_lock(&pool_mu);
+    pool_shutdown = 0;
+    pool_workers = 0;
+  }
+  for (int t = 0; t < want; t++) {
+    if (pthread_create(&pool_tids[t], 0, pool_worker_main, 0) != 0) break;
+    pool_workers = t + 1;
+  }
+  int got = pool_workers + 1;
+  pthread_mutex_unlock(&pool_mu);
+  return got;
+}
+
+int tm_prep_pool_size(void) {
+  pthread_mutex_lock(&pool_mu);
+  int s = pool_workers + 1;
+  pthread_mutex_unlock(&pool_mu);
+  return s;
+}
+
+/* Dispatch `used` jobs: pool when available, else per-call threads with
+ * the last chunk inline (the pre-pool path, kept as fallback). */
+static void run_jobs(pool_fn fn, void *jobs, size_t job_size, int used,
+                     pthread_t *tids) {
+  if (used <= 0) return;
+  if (used > 1 && pool_run(fn, jobs, job_size, used)) return;
+  char *base = (char *)jobs;
+  for (int t = 0; t + 1 < used; t++)
+    pthread_create(&tids[t], 0, fn, base + (size_t)t * job_size);
+  fn(base + (size_t)(used - 1) * job_size); /* run the last chunk inline */
+  for (int t = 0; t + 1 < used; t++) pthread_join(tids[t], 0);
+}
+
+/* ------------------------------------------------------------------ */
 /* Threaded drivers                                                    */
 
 typedef struct {
@@ -304,9 +444,7 @@ void tm_ed25519_h_batch(const uint8_t *sigs, const uint8_t *pks,
     used = t + 1;
     if (hi == n) break;
   }
-  for (int t = 0; t + 1 < used; t++) pthread_create(&tids[t], 0, hash_worker, &jobs[t]);
-  if (used) hash_worker(&jobs[used - 1]); /* run the last chunk inline */
-  for (int t = 0; t + 1 < used; t++) pthread_join(tids[t], 0);
+  run_jobs(hash_worker, jobs, sizeof(hash_job), used, tids);
 }
 
 typedef struct {
@@ -360,9 +498,7 @@ void tm_rlc_scalars(const uint8_t *z, const uint8_t *h, const uint8_t *s,
     used = t + 1;
     if (hi == n) break;
   }
-  for (int t = 0; t + 1 < used; t++) pthread_create(&tids[t], 0, scalar_worker, &jobs[t]);
-  if (used) scalar_worker(&jobs[used - 1]);
-  for (int t = 0; t + 1 < used; t++) pthread_join(tids[t], 0);
+  run_jobs(scalar_worker, jobs, sizeof(scalar_job), used, tids);
   uint64_t total[8] = {0};
   for (int t = 0; t < used; t++) add_limbs(total, jobs[t].acc, 8);
   uint64_t u[4];
@@ -436,8 +572,6 @@ void tm_sort_windows(const uint8_t *digits, int64_t n, int32_t *perm,
     used = t + 1;
     if (hi == 32) break;
   }
-  for (int t = 0; t + 1 < used; t++) pthread_create(&tids[t], 0, sort_worker, &jobs[t]);
-  if (used) sort_worker(&jobs[used - 1]);
-  for (int t = 0; t + 1 < used; t++) pthread_join(tids[t], 0);
+  run_jobs(sort_worker, jobs, sizeof(sort_job), used, tids);
 }
 

@@ -43,6 +43,16 @@ def _open_db(cfg: Config, name: str) -> KVDB:
     return SQLiteDB(os.path.join(cfg.root_dir, "data", f"{name}.db"))
 
 
+def _parse_host_stripe(v):
+    """`[crypto] prep_host_stripe` accepts "auto"/"1"/"0" (or a bool from
+    programmatic configs); None leaves the process-global setting alone."""
+    if v is None or v == "auto":
+        return v
+    if isinstance(v, str):
+        return v not in ("0", "false", "off")
+    return bool(v)
+
+
 def default_app(name: str):
     if name == "kvstore":
         return KVStoreApplication()
@@ -93,6 +103,20 @@ class Node:
         # streamed flush planner budget (same process-global model)
         _batch.configure_planner(
             max_flush_lanes=getattr(config.crypto, "max_flush_lanes", None)
+        )
+        # stage-overlapped host prep + verified-row memo (ISSUE 18; same
+        # process-global, last-node-wins model as the planner/breaker)
+        _batch.configure_prep(
+            prep_threads=getattr(config.crypto, "prep_threads", None),
+            staged=getattr(config.crypto, "prep_staged", None),
+            stream=getattr(config.crypto, "prep_stream", None),
+            stream_floor=getattr(config.crypto, "prep_stream_floor", None),
+            host_stripe=_parse_host_stripe(
+                getattr(config.crypto, "prep_host_stripe", None)
+            ),
+        )
+        _batch.configure_verified_memo(
+            rows=getattr(config.crypto, "verified_memo_rows", None)
         )
         self._owns_priv_validator = False
         if priv_validator is None and config.base.priv_validator_addr:

@@ -1029,18 +1029,25 @@ def _trace_span(name: str, **attrs):
 
 
 def rlc_check_submit(
-    pts_bytes: np.ndarray, scalars: Sequence[int], zero16_from: int = 0
+    pts_bytes: np.ndarray, scalars: Sequence[int], zero16_from: int = 0,
+    presorted=None,
 ):
     """Host prep + async device submit: pts_bytes (N, 32) uint8 encodings,
     [A block | R block] with scalars to match (0 = excluded lane; R-block
     scalars < 2^128). zero16_from: the A/R boundary when known (R-block
     scalars being < 2^128 lets the sort skip those rows in the high
-    windows). Returns an unsynced device bool (1+N,):
-    [batch_ok, lane_ok...] — np.asarray() it to sync."""
+    windows). `presorted=(perm, ends)` skips the digit expansion AND the
+    window sort — the stage-overlapped submit (crypto/batch.py ISSUE 18)
+    sorts on the prep side so this call dispatches immediately. Returns an
+    unsynced device bool (1+N,): [batch_ok, lane_ok...] — np.asarray() it
+    to sync."""
     n = pts_bytes.shape[0]
     with _trace_span("kernel.rlc_submit", variant="plain", lanes=n):
-        digits = scalars_to_bytes(scalars, n)
-        perm, ends = sort_windows(digits, zero16_from=zero16_from)
+        if presorted is not None:
+            perm, ends = presorted
+        else:
+            digits = scalars_to_bytes(scalars, n)
+            perm, ends = sort_windows(digits, zero16_from=zero16_from)
         fctx = make_ctx((n,))
         fused = fused_for_lanes(n)
         _set_submit_fused(fused)
@@ -1102,20 +1109,24 @@ def rlc_check_cached_submit(
     a_coords: Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray],
     r_bytes: np.ndarray,  # (Nr, 32)
     scalars: Sequence[int],  # length Na + Nr, A block first
+    presorted=None,
 ):
     """Cached-A variant of rlc_check_submit (A predecompressed, R by bytes).
-    Returns an unsynced device bool (1+Nr,): [batch_ok, r_ok...]."""
+    `presorted=(perm, ends)` is honored on the HOST-sort arm only (the
+    device-sort arm derives perm/ends in-graph from raw digits and has no
+    host sort to skip). Returns an unsynced device bool (1+Nr,):
+    [batch_ok, r_ok...]."""
     na = a_coords[0].shape[-1]
     nr = r_bytes.shape[0]
     n = na + nr
     with _trace_span("kernel.rlc_submit", variant="cached", lanes=n):
-        digits = scalars_to_bytes(scalars, n)
         fctx = make_ctx((nr,))
         fused = fused_for_lanes(n)
         _set_submit_fused(fused)
         if _device_sort_enabled():
             # digits go down raw; perm/ends are derived in-graph
             # (sort_windows_device) — no host sort, half the wire bytes.
+            digits = scalars_to_bytes(scalars, n)
             return _dispatch(
                 "rlc_cached_ds_f" if fused else "rlc_cached_ds",
                 _rlc_cached_dsort_jit_fused if fused else _rlc_cached_dsort_jit,
@@ -1127,7 +1138,11 @@ def rlc_check_cached_submit(
             )
         # rows >= na are the z-lane (128-bit scalars) + padding: zero digits
         # in windows 16-31, so the sort skips their count pass
-        perm, ends = sort_windows(digits, zero16_from=na)
+        if presorted is not None:
+            perm, ends = presorted
+        else:
+            digits = scalars_to_bytes(scalars, n)
+            perm, ends = sort_windows(digits, zero16_from=na)
         return _dispatch(
             "rlc_cached_f" if fused else "rlc_cached",
             _rlc_cached_jit_fused if fused else _rlc_cached_jit,

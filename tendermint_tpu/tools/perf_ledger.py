@@ -84,6 +84,10 @@ def _scenario_speedups(extra: dict) -> Dict[str, Any]:
             entry["backend"] = res["backend"]
         if isinstance(res.get("tpu_e2e_ms"), (int, float)):
             entry["tpu_e2e_ms"] = res["tpu_e2e_ms"]
+        # prep-overlap column (ISSUE 18): fraction of host-prep wall hidden
+        # behind device/MSM work for the flush the scenario timed
+        if isinstance(res.get("prep_wall_hidden"), (int, float)):
+            entry["prep_hidden"] = res["prep_wall_hidden"]
         if isinstance(res.get("sigs_per_sec"), (int, float)):
             entry["sigs_per_sec"] = res["sigs_per_sec"]
         if res.get("degraded"):
@@ -144,6 +148,10 @@ def parse_bench(path: str) -> dict:
             if host.get(k)
         }
     row["scenarios"] = _scenario_speedups(extra)
+    # headline prep-overlap trajectory (ISSUE 18): rounds before the staged
+    # prep pipeline simply show "—"
+    head = row["scenarios"].get(HEADLINE_SCENARIO) or {}
+    row["prep_hidden"] = head.get("prep_hidden")
     # fleet-gate column (ISSUE 17): rounds that ran the `fleet_soak`
     # scenario carry the referee verdict + heights + safety-violation count;
     # rounds that didn't are flagged like headline_missing — a silently
@@ -303,8 +311,8 @@ def render_markdown(ledger: dict) -> str:
         "",
         "## Bench rounds",
         "",
-        "| round | metric | value | speedup | fleet gate | host | status |",
-        "|---:|---|---:|---:|---|---|---|",
+        "| round | metric | value | speedup | prep hidden | fleet gate | host | status |",
+        "|---:|---|---:|---:|---:|---|---|---|",
     ]
     for r in ledger["bench"]:
         if r["lost"]:
@@ -341,9 +349,14 @@ def render_markdown(ledger: dict) -> str:
         host = r["fingerprint"] or "—"
         if r.get("versions"):
             host += f" ({_fmt_versions(r['versions'])})"
+        hidden = (
+            f"{r['prep_hidden']:.0%}"
+            if isinstance(r.get("prep_hidden"), (int, float))
+            else "—"
+        )
         lines.append(
             f"| {_round_label(r)} | {r['metric'] or '—'} | {value} "
-            f"| {speed} | {fleet} | {host} | {status} |"
+            f"| {speed} | {hidden} | {fleet} | {host} | {status} |"
         )
     lines += ["", "### Per-scenario speedups", ""]
     scen_names: List[str] = []
@@ -362,12 +375,17 @@ def render_markdown(ledger: dict) -> str:
                 s = r["scenarios"].get(name)
                 if s and s.get("backend"):
                     backend = s["backend"]
+                hid = (
+                    f"·h{s['prep_hidden']:.0%}"
+                    if s and isinstance(s.get("prep_hidden"), (int, float))
+                    else ""
+                )
                 if not s:
                     cells.append("—")
                 elif s.get("degraded"):
-                    cells.append("cpu!")
+                    cells.append("cpu!" + hid)
                 elif "speedup" in s:
-                    cells.append(f"{s['speedup']:.2f}×")
+                    cells.append(f"{s['speedup']:.2f}×{hid}")
                 elif "sigs_per_sec" in s:
                     cells.append(f"{s['sigs_per_sec']:,}/s")
                 else:

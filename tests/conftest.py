@@ -77,6 +77,22 @@ requires_cryptography = pytest.mark.skipif(
 )
 
 
+@pytest.fixture(autouse=True)
+def _verified_memo_off():
+    """The cross-flush verified-row memo (crypto/batch.py ISSUE 18) is
+    process-global state that changes which flushes run device work — a
+    repeat verify of the same rows answers from the memo. Tests assert
+    path/flush-count behavior on exactly such repeats, so each test runs
+    with the memo DISABLED unless it installs one itself
+    (configure_verified_memo / node config)."""
+    from tendermint_tpu.crypto import batch
+
+    prev = batch._MEMO
+    batch._MEMO = batch.VerifiedRowMemo(0)
+    yield
+    batch._MEMO = prev
+
+
 def free_compile_memory() -> None:
     """Drop every previously-compiled executable in this process. Used as a
     module fixture by the heavyweight kernel test modules: XLA ABORTED

@@ -117,8 +117,8 @@ def _install_host_twins(monkeypatch, tracker=None):
             bool(acc[2] % ref.P != 0 and ref.point_equal(acc, ref.IDENTITY))
         )
 
-    def full_submit(pts_bytes, scalars, zero16_from=0):
-        total, ok = partial_submit(pts_bytes, scalars)
+    def full_submit(pts_bytes, scalars, zero16_from=0, presorted=None):
+        total, ok = partial_submit(pts_bytes, scalars, presorted=presorted)
         if tracker is not None:
             ok = np.asarray(ok)
         bok = bool(total[2] % ref.P != 0 and ref.point_equal(total, ref.IDENTITY))

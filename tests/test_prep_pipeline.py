@@ -1,0 +1,303 @@
+"""ISSUE 18 — prep-pipeline tests: the staged single-flush submit (hashing
+on the prep pool, A-block upload early, sort hoisted), the in-budget
+2-chunk pipelined stream, and the striped host-RLC path.
+
+The invariants pinned here:
+  - byte identity: staged == serial == CPU verdicts, bit for bit, across
+    geometries and with precheck-rejected rows at stage boundaries;
+  - a prep-pool hashing failure latches in the future and fails the flush
+    LOUDLY (and the pool is still usable afterwards);
+  - hot-path hash budget: a clean flush challenge-hashes every row AT MOST
+    once (batch.HASH_ROWS_HASHED);
+  - the pipelined path engages only inside its geometry guard, labels
+    itself "rlc-pipelined", and records 2-chunk overlap telemetry;
+  - the striped host-RLC path returns verdicts identical to the unstriped
+    path, including exact recovery around a tampered row.
+
+Device kernels are replaced with ed25519_ref host twins (identical math,
+real curve points) — see tests/test_flush_planner.py.
+"""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu import native
+from tendermint_tpu.crypto import batch
+from tendermint_tpu.crypto.keys import gen_ed25519
+from test_flush_planner import _install_host_twins, _signed_rows
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native helper module unavailable"
+)
+
+
+@pytest.fixture
+def prep_cfg():
+    """Snapshot/restore the process-global prep-pipeline config."""
+    prev = dict(batch._PREP_CFG)
+    yield batch._PREP_CFG
+    batch._PREP_CFG.clear()
+    batch._PREP_CFG.update(prev)
+
+
+@pytest.fixture
+def small_rlc(monkeypatch, prep_cfg):
+    """RLC_MIN=8 + a 64-lane planner bucket (31 rows/chunk), restored after."""
+    monkeypatch.setattr(batch, "RLC_MIN", 8)
+    prev = batch.planner_budget()
+    batch.configure_planner(max_flush_lanes=64)
+    yield 31
+    batch.configure_planner(max_flush_lanes=prev)
+    batch.set_device_fault_hook(None)
+
+
+def _rows_with_rejects(n, seed=b"\x21"):
+    """n signed rows with stage-boundary rejects mixed in: a non-canonical
+    s (>= L, rejected at precheck BEFORE hashing), an invalid pubkey
+    encoding (rejected at the A-cache fill boundary), and a tampered
+    message (valid encodings; only the combined check can catch it)."""
+    pks, msgs, sigs = _signed_rows(n, seed)
+    pks, msgs, sigs = list(pks), list(msgs), list(sigs)
+    expect = np.ones(n, dtype=bool)
+    # row 1: s >= L — precheck reject, stage-1 boundary
+    sigs[1] = sigs[1][:32] + b"\xff" * 32
+    expect[1] = False
+    # row 3: y >= p — invalid point encoding, A-fill boundary
+    pks[3] = b"\xff" * 32
+    expect[3] = False
+    # row n-2: bitflipped message — combined-check failure, recovery path
+    msgs[n - 2] = msgs[n - 2][:-1] + bytes([msgs[n - 2][-1] ^ 1])
+    expect[n - 2] = False
+    return pks, msgs, sigs, expect
+
+
+# ---------------------------------------------------------------------------
+# staged single-flush submit
+
+
+@needs_native
+@pytest.mark.parametrize("n", [9, 16, 31], ids=["tiny", "pow2", "bucket-edge"])
+def test_staged_vs_serial_vs_cpu_byte_identical(small_rlc, monkeypatch,
+                                                prep_cfg, n):
+    """Staged submit == serial submit == CPU host path, bit for bit."""
+    _install_host_twins(monkeypatch)
+    pks, msgs, sigs = _signed_rows(n, b"\x22")
+    cpu = batch.verify_batch_cpu(pks, msgs, sigs)
+
+    prep_cfg["stream"] = False  # isolate the staged single flush
+    prep_cfg["staged"] = True
+    staged = batch.verify_batch(pks, msgs, sigs, backend="jax")
+    prep_cfg["staged"] = False
+    serial = batch.verify_batch(pks, msgs, sigs, backend="jax")
+
+    assert staged.tobytes() == serial.tobytes() == cpu.tobytes()
+    assert staged.all()
+
+
+@needs_native
+def test_staged_precheck_rejected_rows_at_stage_boundaries(small_rlc,
+                                                           monkeypatch,
+                                                           prep_cfg):
+    """Rows rejected at each stage boundary (pre-hash precheck, A-fill
+    exclusion, combined-check recovery) produce verdicts identical to the
+    serial path and the CPU referee."""
+    _install_host_twins(monkeypatch)
+    pks, msgs, sigs, expect = _rows_with_rejects(20)
+    cpu = batch.verify_batch_cpu(pks, msgs, sigs)
+
+    prep_cfg["stream"] = False
+    prep_cfg["staged"] = True
+    staged = batch.verify_batch(pks, msgs, sigs, backend="jax")
+    prep_cfg["staged"] = False
+    serial = batch.verify_batch(pks, msgs, sigs, backend="jax")
+
+    assert staged.tobytes() == serial.tobytes() == cpu.tobytes()
+    assert staged.tobytes() == expect.tobytes()
+
+
+@needs_native
+def test_prep_pool_exception_fails_flush_loudly(small_rlc, monkeypatch,
+                                                prep_cfg):
+    """A hashing failure on the prep pool latches in the future, re-raises
+    at .result() on the dispatch thread, and leaves the pool usable."""
+    _install_host_twins(monkeypatch)
+    prep_cfg["stream"] = False
+    prep_cfg["staged"] = True
+    pks, msgs, sigs = _signed_rows(12, b"\x23")
+
+    real = native.ed25519_h_batch
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected prep-pool hash failure")
+
+    monkeypatch.setattr(native, "ed25519_h_batch", boom)
+    with pytest.raises(RuntimeError, match="injected prep-pool hash"):
+        batch._rlc_submit(pks, msgs, sigs)
+
+    # the pool is not wedged: the very next staged flush succeeds
+    monkeypatch.setattr(native, "ed25519_h_batch", real)
+    mask = batch.verify_batch(pks, msgs, sigs, backend="jax")
+    assert mask.all()
+
+
+@needs_native
+def test_hash_budget_at_most_once_per_row(small_rlc, monkeypatch, prep_cfg):
+    """Hot-path guard: a clean flush challenge-hashes each row EXACTLY once
+    — on the staged single flush and on the pipelined 2-chunk stream."""
+    _install_host_twins(monkeypatch)
+    pks, msgs, sigs = _signed_rows(24, b"\x24")
+
+    prep_cfg["stream"] = False
+    prep_cfg["staged"] = True
+    batch.HASH_ROWS_HASHED[0] = 0
+    assert batch.verify_batch(pks, msgs, sigs, backend="jax").all()
+    assert batch.HASH_ROWS_HASHED[0] == 24
+
+    prep_cfg["stream"] = True
+    prep_cfg["stream_floor"] = 16
+    batch.HASH_ROWS_HASHED[0] = 0
+    assert batch.verify_batch(pks, msgs, sigs, backend="jax").all()
+    assert batch.LAST_JAX_PATH[0] == "rlc-pipelined"
+    assert batch.HASH_ROWS_HASHED[0] == 24
+
+
+# ---------------------------------------------------------------------------
+# pipelined in-budget 2-chunk stream
+
+
+def test_pipelined_byte_identical_and_telemetry(small_rlc, monkeypatch,
+                                                prep_cfg):
+    """Above the stream floor (and inside the planner budget) a single
+    flush rides TWO asymmetric chunks, labels itself rlc-pipelined, and
+    records chunks/prep_overlap_s/prep_stages — verdicts byte-identical to
+    the unstriped serial flush and the CPU path."""
+    _install_host_twins(monkeypatch)
+    pks, msgs, sigs = _signed_rows(24, b"\x25")
+    cpu = batch.verify_batch_cpu(pks, msgs, sigs)
+
+    prep_cfg["stream"] = True
+    prep_cfg["stream_floor"] = 16
+    piped = batch.verify_batch(pks, msgs, sigs, backend="jax")
+    assert batch.LAST_JAX_PATH[0] == "rlc-pipelined"
+    det = dict(batch.LAST_FLUSH_DETAIL)
+
+    prep_cfg["stream"] = False
+    single = batch.verify_batch(pks, msgs, sigs, backend="jax")
+
+    assert piped.tobytes() == single.tobytes() == cpu.tobytes()
+    assert piped.all()
+    assert det.get("chunks") == 2
+    assert det.get("prep_overlap_s") is not None
+    assert isinstance(det.get("prep_stages"), dict)
+
+
+def test_pipelined_geometry_guard_declines(small_rlc, monkeypatch, prep_cfg):
+    """A tail chunk past the planner bucket makes _verify_batch_pipelined
+    decline (return None) instead of compiling a new shape."""
+    _install_host_twins(monkeypatch)
+    # n=40: head = max(8, 5) = 8, tail = 32 > 31-row chunk bucket
+    pks, msgs, sigs = _signed_rows(40, b"\x26")
+    assert batch._verify_batch_pipelined(pks[:40], msgs[:40], sigs[:40]) is None
+
+
+def test_pipelined_bad_row_exact_recovery(small_rlc, monkeypatch, prep_cfg):
+    """A tampered row in a pipelined flush still resolves to the exact
+    per-row mask (combined check fails -> per-signature ladder)."""
+    _install_host_twins(monkeypatch)
+    pks, msgs, sigs, expect = _rows_with_rejects(24, b"\x27")
+    prep_cfg["stream"] = True
+    prep_cfg["stream_floor"] = 16
+    mask = batch.verify_batch(pks, msgs, sigs, backend="jax")
+    assert mask.tobytes() == expect.tobytes()
+    cpu = batch.verify_batch_cpu(pks, msgs, sigs)
+    assert mask.tobytes() == cpu.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# striped host RLC
+
+
+def _tiled_rows(n, base, seed=b"\x28"):
+    pks, msgs, sigs = _signed_rows(base, seed)
+    reps = -(-n // base)
+    return (
+        (list(pks) * reps)[:n],
+        (list(msgs) * reps)[:n],
+        (list(sigs) * reps)[:n],
+    )
+
+
+def test_striped_host_rlc_parity_and_overlap(prep_cfg):
+    """The striped host-RLC path (stream on, n >= floor) returns verdicts
+    identical to the unstriped host path, and records the pipelined
+    overlap telemetry (prep_overlap_s, prep_stages, chunks)."""
+    n = 2100  # 1024-row stripe floor -> 3 stripes
+    pks, msgs, sigs = _tiled_rows(n, 128)
+
+    prep_cfg["stream"] = True
+    prep_cfg["stream_floor"] = 512
+    prep_cfg["host_stripe"] = True  # force: "auto" is off on 1-core hosts
+    striped = batch.verify_batch_cpu(pks, msgs, sigs)
+    det = dict(batch.LAST_FLUSH_DETAIL)
+
+    prep_cfg["stream"] = False
+    serial = batch.verify_batch_cpu(pks, msgs, sigs)
+
+    assert striped.tobytes() == serial.tobytes()
+    assert striped.all()
+    assert det.get("chunks") == 3
+    assert det.get("prep_overlap_s") is not None
+    assert isinstance(det.get("prep_stages"), dict)
+    assert det.get("prep_s") is not None
+
+
+def test_striped_host_rlc_bad_row_exact(prep_cfg):
+    """A tampered row inside one stripe recovers the exact serial mask."""
+    n = 1100  # 2 stripes (1024 + 76)
+    pks, msgs, sigs = _tiled_rows(n, 64, b"\x29")
+    msgs[1050] = msgs[1050][:-1] + bytes([msgs[1050][-1] ^ 1])
+
+    prep_cfg["stream"] = True
+    prep_cfg["stream_floor"] = 512
+    prep_cfg["host_stripe"] = True
+    striped = batch.verify_batch_cpu(pks, msgs, sigs)
+    prep_cfg["stream"] = False
+    serial = batch.verify_batch_cpu(pks, msgs, sigs)
+
+    assert striped.tobytes() == serial.tobytes()
+    assert not striped[1050]
+    assert striped.sum() == n - 1
+
+
+# ---------------------------------------------------------------------------
+# native prep pool config
+
+
+@needs_native
+def test_prep_pool_configure_roundtrip():
+    """configure_prep(prep_threads=...) resizes the native worker pool;
+    0/None restores the host default min(cores, 8)."""
+    import os
+
+    default = min(8, os.cpu_count() or 1)
+    try:
+        batch.configure_prep(prep_threads=2)
+        assert native.prep_pool_size() == 2
+        batch.configure_prep(prep_threads=3)
+        assert native.prep_pool_size() == 3
+    finally:
+        batch.configure_prep(prep_threads=0)
+    assert native.prep_pool_size() == default
+
+
+def test_config_plumbing_defaults():
+    """CryptoConfig carries the ISSUE 18 knobs with production defaults."""
+    from tendermint_tpu.config.config import CryptoConfig
+
+    c = CryptoConfig()
+    assert c.prep_threads == 0
+    assert c.prep_staged is True
+    assert c.prep_stream is True
+    assert c.prep_stream_floor == 2048
+    assert c.prep_host_stripe == "auto"
+    assert c.verified_memo_rows == 65536

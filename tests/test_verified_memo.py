@@ -1,0 +1,218 @@
+"""ISSUE 18 — cross-flush verified-row memo safety tests.
+
+The memo (crypto/batch.VerifiedRowMemo) caches digests of rows that
+verified OK so a commit assembled from deferred-verified live votes does
+not re-pay device/host verification for the same rows. The safety
+contract pinned here:
+
+  - only verdict-True rows are ever inserted; a flush that raises inserts
+    NOTHING (never-cache-on-failure);
+  - a tampered byte anywhere in (key_type, pubkey, msg, sig) produces a
+    different digest: the tampered row misses, re-verifies, and fails —
+    the memo can never turn a False verdict into a True one;
+  - the LRU eviction bound holds under a 10k-row flood;
+  - capacity 0 disables the memo entirely (the test-suite default via
+    tests/conftest.py);
+  - integration: a commit built from a deferred-verified VoteSet resolves
+    through the memo with ZERO re-verified rows.
+
+The suite-wide conftest fixture swaps in a disabled memo per test; tests
+here enable one explicitly through configure_verified_memo.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import batch
+from tendermint_tpu.crypto.keys import gen_ed25519
+from tendermint_tpu.libs import trace as _trace
+
+
+def _memo_on(rows=4096):
+    batch.configure_verified_memo(rows)
+    return batch._MEMO
+
+
+def _signed(n, seed=b"\x31"):
+    priv = gen_ed25519(seed * 32 if len(seed) == 1 else seed)
+    pk = priv.pub_key().bytes()
+    msgs = [b"memo-%05d" % i for i in range(n)]
+    return [pk] * n, msgs, [priv.sign(m) for m in msgs]
+
+
+def _last_flush():
+    return _trace.verify_stats()["last_flush"]
+
+
+# ---------------------------------------------------------------------------
+# hit/miss semantics
+
+
+def test_full_hit_short_circuits():
+    memo = _memo_on()
+    pks, msgs, sigs = _signed(60)
+    assert batch.verify_batch(pks, msgs, sigs).all()
+    assert len(memo) == 60
+    assert memo.stats()["insertions"] == 60
+
+    mask = batch.verify_batch(pks, msgs, sigs)
+    assert mask.all() and len(mask) == 60
+    st = memo.stats()
+    assert st["hits"] == 60
+    lf = _last_flush()
+    assert lf["backend"] == "memo" and lf["path"] == "memo"
+    assert lf["memo_hits"] == 60
+
+
+def test_partial_hit_verifies_residue_only():
+    memo = _memo_on()
+    pks, msgs, sigs = _signed(60)
+    assert batch.verify_batch(pks[:40], msgs[:40], sigs[:40]).all()
+    hits0 = memo.stats()["hits"]
+
+    mask = batch.verify_batch(pks, msgs, sigs)
+    assert mask.all() and len(mask) == 60
+    assert memo.stats()["hits"] == hits0 + 40
+    # the residue flush (recorded after the memo flush) carried ONLY the
+    # 20 unseen rows — and re-inserted them for next time
+    assert _last_flush()["n"] == 20
+    assert len(memo) == 60
+
+
+def test_tampered_row_never_hits_memo():
+    memo = _memo_on()
+    pks, msgs, sigs = _signed(30, b"\x32")
+    assert batch.verify_batch(pks, msgs, sigs).all()
+
+    msgs = list(msgs)
+    msgs[7] = msgs[7][:-1] + bytes([msgs[7][-1] ^ 1])
+    mask = batch.verify_batch(pks, msgs, sigs)
+    assert not mask[7]
+    assert mask.sum() == 29
+
+    # the tampered digest is not in the memo — and never got inserted
+    d = memo.digest_rows([pks[7]], [msgs[7]], [sigs[7]])[0]
+    assert d not in memo
+    assert memo.stats()["insertions"] == 30
+    # repeat: the verdict stays False (the memo cannot launder a failure)
+    assert not batch.verify_batch(pks, msgs, sigs)[7]
+
+
+def test_bad_rows_never_cached():
+    memo = _memo_on()
+    pks, msgs, sigs = _signed(20, b"\x33")
+    sigs = list(sigs)
+    sigs[4] = sigs[4][:32] + b"\xff" * 32  # non-canonical s: verdict False
+    mask = batch.verify_batch(pks, msgs, sigs)
+    assert not mask[4] and mask.sum() == 19
+    assert len(memo) == 19
+    d = memo.digest_rows([pks[4]], [msgs[4]], [sigs[4]])[0]
+    assert d not in memo
+
+
+def test_failed_flush_caches_nothing(monkeypatch):
+    memo = _memo_on()
+    pks, msgs, sigs = _signed(16, b"\x34")
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected flush failure")
+
+    monkeypatch.setattr(batch, "_verify_batch_routed", boom)
+    with pytest.raises(RuntimeError, match="injected flush failure"):
+        batch.verify_batch(pks, msgs, sigs)
+    assert len(memo) == 0
+    assert memo.stats()["insertions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# bounds and disablement
+
+
+def test_eviction_bound_under_10k_flood():
+    memo = batch.VerifiedRowMemo(1000)
+    rng = np.random.default_rng(7)
+    digests = [rng.bytes(32) for _ in range(10_000)]
+    ones = np.ones(1000, dtype=bool)
+    for lo in range(0, 10_000, 1000):
+        memo.insert(digests[lo : lo + 1000], ones)
+    st = memo.stats()
+    assert len(memo) == 1000
+    assert st["insertions"] == 10_000
+    assert st["evictions"] == 9_000
+    # LRU: the newest 1000 survive, the oldest 9000 are gone
+    assert memo.lookup(digests[-1000:]).all()
+    assert not memo.lookup(digests[:1000]).any()
+
+
+def test_capacity_zero_disables():
+    memo = _memo_on(0)
+    pks, msgs, sigs = _signed(12, b"\x35")
+    assert batch.verify_batch(pks, msgs, sigs).all()
+    assert batch.verify_batch(pks, msgs, sigs).all()  # re-verified, no memo
+    st = memo.stats()
+    assert st["capacity"] == 0
+    assert st["hits"] == 0 and st["insertions"] == 0
+    assert len(memo) == 0
+
+
+def test_digest_framing_is_unambiguous():
+    """pk||msg boundary shifts must produce different digests (the frame
+    prevents "ab"+"c" aliasing "a"+"bc")."""
+    memo = batch.VerifiedRowMemo(16)
+    d1 = memo.digest_rows([b"ab"], [b"c"], [b"sig"])[0]
+    d2 = memo.digest_rows([b"a"], [b"bc"], [b"sig"])[0]
+    assert d1 != d2
+
+
+def test_scheduler_stats_carry_memo_block():
+    _memo_on(128)
+    pks, msgs, sigs = _signed(8, b"\x36")
+    assert batch.verify_batch(pks, msgs, sigs).all()
+    assert batch.verified_memo_stats()["insertions"] == 8
+
+
+# ---------------------------------------------------------------------------
+# integration: deferred-verified votes -> commit verify through the memo
+
+
+def test_deferred_commit_verifies_through_memo():
+    """The consensus shape the memo exists for: precommits batch-verified
+    by the deferred VoteSet flush populate the memo; the commit assembled
+    from those SAME votes then verifies with zero re-verified rows."""
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    memo = _memo_on()
+    rng = np.random.default_rng(42)
+    privs = [
+        gen_ed25519(rng.integers(0, 256, 32, dtype=np.uint8).tobytes())
+        for _ in range(48)
+    ]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    sorted_privs = [by_addr[v.address] for v in vals.validators]
+    bid = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+
+    vs = VoteSet("memo-chain", 1, 0, 2, vals, defer_verification=True)
+    for i, (val, priv) in enumerate(zip(vals.validators, sorted_privs)):
+        v = Vote(type=2, height=1, round=0, block_id=bid, timestamp_ns=0,
+                 validator_address=val.address, validator_index=i)
+        v = dataclasses.replace(v, signature=priv.sign(v.sign_bytes("memo-chain")))
+        assert vs.add_vote(v) == "pending"
+    committed, failed = vs.flush()
+    assert len(committed) == 48 and not failed
+    assert len(memo) == 48  # the deferred flush populated the memo
+
+    commit = vs.make_commit()
+    misses0 = memo.stats()["misses"]
+    vals.verify_commit("memo-chain", bid, 1, commit)  # must not raise
+
+    st = memo.stats()
+    assert st["misses"] == misses0  # ZERO re-verified rows
+    assert st["hits"] == 48        # the commit's full memo hit
+    lf = _last_flush()
+    assert lf["backend"] == "memo" and lf["memo_hits"] == 48
