@@ -1763,6 +1763,163 @@ def bench_multichip(n: int = 4096):
         B._SHARDED_RUNNER = None
 
 
+def bench_mesh_failover(n: int = 2048):
+    """ISSUE 19 elastic mesh: throughput BEFORE / DURING / AFTER a seeded
+    device loss on the sharded mesh, the rebuild latency, and a zero-lost
+    -verdicts check. One mesh device is declared lost mid-run (chaos
+    injector, deterministic): the faulted flush replays on the survivor
+    mesh and must return the byte-identical verdict mask; subsequent
+    flushes stay SHARDED (survivor rung, not CPU-degraded); after revive +
+    clean probes the device re-joins and full-mesh throughput returns. On
+    a CPU-only host the mesh is 8 VIRTUAL devices (same XLA flag as the
+    multichip scenario): numbers prove the plumbing, not the hardware."""
+    import jax
+
+    from tendermint_tpu.chaos.device import DeviceFaultInjector
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.parallel import telemetry as mesh_tm
+    from tendermint_tpu.parallel.health import MESH_HEALTH
+
+    devices = jax.devices()
+    report = {
+        "n": n,
+        "devices_visible": len(devices),
+        "platform": devices[0].platform if devices else "none",
+        "virtual_devices": bool(devices) and devices[0].platform == "cpu",
+    }
+    pubkeys, msgs, sigs, _ = make_batch(n)
+
+    os.environ["TMTPU_SHARDED"] = "1"
+    B._SHARDED_RUNNER = None
+    B.BREAKER.reset()
+    MESH_HEALTH.reset()
+    old_memo = B._MEMO
+    B.configure_verified_memo(rows=0)  # repeat flushes must hit the device
+    old_spawn = MESH_HEALTH._spawn_probe_thread
+    MESH_HEALTH._spawn_probe_thread = False  # drive probes deterministically
+    inj = DeviceFaultInjector().install()
+    try:
+        env = B._sharded_env()
+        if env is None:
+            report["error"] = "no multi-device mesh available"
+            return report
+        nd_full = env[0]
+        report["n_devices"] = nd_full
+
+        def _best_of(k: int) -> float:
+            best = float("inf")
+            for _ in range(k):
+                t0 = time.perf_counter()
+                mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+                best = min(best, time.perf_counter() - t0)
+                assert mask.all()
+            return best
+
+        log(f"[mesh_failover] full mesh ({nd_full} devices): warm + baseline...")
+        baseline_mask = B.verify_batch_jax(pubkeys, msgs, sigs)  # compile
+        assert baseline_mask.all() and B.LAST_JAX_PATH[0] == "rlc-sharded"
+        before = _best_of(3)
+        report["before"] = {
+            "e2e_ms": round(before * 1e3, 3),
+            "sigs_per_sec": round(n / before),
+            "ladder": B.mesh_ladder_state(),
+        }
+
+        # -- DURING: lose the last mesh device; the flush must replay on the
+        # survivor mesh and lose zero verdicts --------------------------------
+        log(f"[mesh_failover] losing device {nd_full - 1} mid-run...")
+        inj.arm_device_lost(nd_full - 1)
+        t0 = time.perf_counter()
+        mask = B.verify_batch_jax(pubkeys, msgs, sigs)
+        during = time.perf_counter() - t0
+        lost_verdicts = int(n - int(np.asarray(mask).sum()))
+        byte_identical = bool(
+            (np.asarray(mask) == np.asarray(baseline_mask)).all()
+        )
+        surv_env = B._sharded_env()
+        report["during"] = {
+            "e2e_ms": round(during * 1e3, 3),
+            "path": B.LAST_JAX_PATH[0],
+            "mesh_replays": B.LAST_FLUSH_DETAIL.get("mesh_replays", 0),
+            "lost_verdicts": lost_verdicts,
+            "mask_byte_identical": byte_identical,
+            "survivor_devices": surv_env[0] if surv_env else 0,
+        }
+        assert lost_verdicts == 0, f"{lost_verdicts} verdicts lost in failover"
+        assert byte_identical, "failover mask diverged from the baseline"
+
+        # -- degraded steady state: still SHARDED, on the survivor mesh ------
+        degraded_best = _best_of(3)
+        report["degraded"] = {
+            "e2e_ms": round(degraded_best * 1e3, 3),
+            "sigs_per_sec": round(n / degraded_best),
+            "path": B.LAST_JAX_PATH[0],
+            "ladder": B.mesh_ladder_state(),
+        }
+        assert B.LAST_JAX_PATH[0] == "rlc-sharded", (
+            f"post-loss flushes CPU-degraded: {B.LAST_JAX_PATH[0]}"
+        )
+        stats = mesh_tm.mesh_stats()
+        report["rebuild_s"] = (stats.get("last_rebuild") or {}).get("seconds")
+        report["rebuilds"] = stats.get("rebuilds", 0)
+
+        # -- AFTER: revive, clean probes, rejoin, full-mesh steady state -----
+        log("[mesh_failover] reviving the lost device...")
+        inj.revive_device()
+        probes = 0
+        while MESH_HEALTH.dead_count() and probes < 16:
+            MESH_HEALTH.probe_round()
+            probes += 1
+        after = _best_of(3)
+        after_env = B._sharded_env()
+        report["after"] = {
+            "e2e_ms": round(after * 1e3, 3),
+            "sigs_per_sec": round(n / after),
+            "n_devices": after_env[0] if after_env else 0,
+            "rejoin_probes": probes,
+            "ladder": B.mesh_ladder_state(),
+        }
+        # the ledger's mesh-degrade column: survivor-mesh throughput as a
+        # fraction of the full mesh's on the SAME host (plus the final rung)
+        report["degrade_ratio"] = round(before / degraded_best, 3)
+        report["mesh_ladder"] = report["after"]["ladder"]
+        report["mesh_telemetry"] = mesh_tm.mesh_stats()
+        return report
+    finally:
+        inj.uninstall()
+        inj.heal()
+        MESH_HEALTH.reset()
+        MESH_HEALTH._spawn_probe_thread = old_spawn
+        B._MEMO = old_memo
+        B.BREAKER.reset()
+        os.environ.pop("TMTPU_SHARDED", None)
+        B._SHARDED_RUNNER = None
+
+
+def bench_mesh_failover_host(n: int = 2048):
+    """CPU-fallback twin of mesh_failover: no mesh exists in the degraded
+    child, so this measures the ladder's BOTTOM rung — the chunked host-RLC
+    path the elastic mesh degrades to when every device is gone — and
+    stamps the ladder state so the column never reads as a silent pass."""
+    from tendermint_tpu.crypto import batch as B
+
+    pubkeys, msgs, sigs, _ = make_batch(n)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        mask = B.verify_batch(pubkeys, msgs, sigs)
+        best = min(best, time.perf_counter() - t0)
+        assert mask.all()
+    return {
+        "n": n,
+        "e2e_ms": round(best * 1e3, 3),
+        "sigs_per_sec": round(n / best),
+        "host_rlc": bool(B.LAST_FLUSH_DETAIL.get("host_rlc")),
+        "mesh_ladder": "host",
+        "degraded": "cpu-fallback",
+    }
+
+
 def bench_tx_admission(
     flood_s: float = 8.0,
     batch_txs: int = 256,
@@ -2134,6 +2291,7 @@ _SCENARIO_PLAN = [
     ("light_serve", 60.0, 300.0),
     ("tx_admission", 120.0, 500.0),
     ("multichip", 240.0, 700.0),
+    ("mesh_failover", 240.0, 700.0),
     ("live_consensus", 240.0, 500.0),
     ("aggregate_verify", 60.0, 500.0),
 ]
@@ -2173,6 +2331,7 @@ def _scenario_fns() -> dict:
     fns["light_serve"] = bench_light_serve
     fns["tx_admission"] = bench_tx_admission
     fns["multichip"] = bench_multichip
+    fns["mesh_failover"] = bench_mesh_failover
     fns["live_consensus"] = bench_live_consensus
     fns["aggregate_verify"] = bench_aggregate_verify
     # harness self-test scenarios (tests/test_bench_guard.py): cheap,
@@ -2290,6 +2449,9 @@ def _cpu_fallback_fns() -> dict:
     fns["aggregate_verify"] = lambda: bench_aggregate_verify(
         sizes=(1000, 10000), persig_sample=2
     )
+    # no mesh exists in the degraded child: measure the ladder's bottom
+    # rung (chunked host-RLC) instead, clearly stamped mesh_ladder=host
+    fns["mesh_failover"] = bench_mesh_failover_host
     return fns
 
 
@@ -2459,7 +2621,7 @@ def _run_scenario_child(name: str, deadline_s: float, degraded: bool = False,
 
     env = dict(os.environ, TMTPU_BENCH_SCENARIO=name)
     env["TMTPU_BENCH_SCENARIO_BUDGET_S"] = str(max(60, int(deadline_s - 90)))
-    if name == "multichip":
+    if name in ("multichip", "mesh_failover"):
         # the sharded arm needs a mesh: on hosts without 8 real chips, 8
         # VIRTUAL CPU devices (flag only affects the CPU platform — a real
         # TPU host's devices win). Must land BEFORE the child imports jax.

@@ -95,6 +95,26 @@ class LocalChaosNet:
     def device_hang(self, seconds: float) -> None:
         self.injector.arm_hang(seconds)
 
+    def shard_error(self, shard: int) -> None:
+        """Next sharded dispatch fails at lane slice `shard` (ISSUE 19)."""
+        self.injector.arm_shard_error(shard)
+
+    def shard_hang(self, shard: int, seconds: float) -> None:
+        """Next sharded dispatch straggles `seconds` at lane slice `shard`."""
+        self.injector.arm_shard_hang(shard, seconds)
+
+    def device_lost(self, device) -> None:
+        """Mesh device dies: every dispatch including it raises and its
+        health probes fail until device_revive. `device` is an index into
+        the mesh's device list (or an explicit device string)."""
+        self.injector.arm_device_lost(device)
+
+    def device_revive(self, device=None) -> None:
+        """Lost device's probes pass again; rejoin cycle can run. An index
+        revives whatever device string it resolved to at dispatch time;
+        None revives all."""
+        self.injector.revive_device(device)
+
     # -- network faults ------------------------------------------------------
 
     def _group_of(self, i: int) -> Optional[set]:

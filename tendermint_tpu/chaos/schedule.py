@@ -20,6 +20,12 @@ Event kinds and their params:
   heal          {}                           clear partitions, re-dial mesh
   crash         {"target": i, "wal_fault": None|"truncate"|"corrupt"}
   restart       {"target": i}
+  shard_error   {"shard": j}                 next sharded dispatch fails at shard j
+  shard_hang    {"shard": j, "seconds": s}   next sharded dispatch straggles at shard j
+  device_lost   {"device": j}                mesh device j dies (every dispatch
+                                             including it fails, probes fail)
+  device_revive {"device": j}                device j's probes pass again; the
+                                             health model runs its rejoin cycle
   peer_stall    {"target": i, "seconds": s}  node i swallows block requests
   peer_lie      {"target": i, "count": k}    node i serves k commit-tampered blocks
   chunk_corrupt {"target": i, "count": k}    node i serves k bit-rotted snapshot chunks
@@ -40,6 +46,10 @@ from typing import List, Sequence, Tuple
 LEVEL_BY_KIND = {
     "device_error": "device",
     "device_hang": "device",
+    "shard_error": "device",
+    "shard_hang": "device",
+    "device_lost": "device",
+    "device_revive": "device",
     "partition": "network",
     "heal": "network",
     "crash": "process",
@@ -148,6 +158,7 @@ class ChaosSchedule:
         max_episode: float = 5.0,
         protected: Sequence[int] = (),
         start_delay: float = 2.0,
+        mesh_devices: int = 8,
     ) -> "ChaosSchedule":
         """Deterministic episode schedule. `protected` node indices are never
         crashed (e.g. the byzantine equivocator, whose misbehavior the soak
@@ -193,6 +204,27 @@ class ChaosSchedule:
                         t, "device_hang", seconds=round(rng.uniform(0.05, 0.3), 3)
                     )
                 )
+            elif kind == "shard_error":
+                events.append(
+                    FaultEvent.make(
+                        t, "shard_error", shard=rng.randrange(mesh_devices)
+                    )
+                )
+            elif kind == "shard_hang":
+                events.append(
+                    FaultEvent.make(
+                        t, "shard_hang", shard=rng.randrange(mesh_devices),
+                        seconds=round(rng.uniform(0.05, 0.3), 3),
+                    )
+                )
+            elif kind == "device_lost":
+                device = rng.randrange(mesh_devices)
+                dur = rng.uniform(min_episode, max_episode)
+                events.append(FaultEvent.make(t, "device_lost", device=device))
+                events.append(
+                    FaultEvent.make(t + dur, "device_revive", device=device)
+                )
+                t += dur
             elif kind == "peer_stall":
                 events.append(
                     FaultEvent.make(

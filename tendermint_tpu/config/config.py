@@ -427,6 +427,23 @@ class CryptoConfig:
     # verified OK; a commit assembled from deferred-verified votes flushes
     # only the unseen residue). 0 disables.
     verified_memo_rows: int = 65536
+    # Elastic mesh health model (parallel/health.py, ISSUE 19): per-device
+    # failure/stall scoring drives the degrade ladder full -> survivor ->
+    # single -> host instead of the breaker's all-or-nothing trip.
+    mesh_health_enabled: bool = True
+    # consecutive attributed failures before a device is declared dead and
+    # the mesh rebuilds over the survivors
+    mesh_health_fail_threshold: int = 2
+    # a sharded dispatch slower than this (seconds) scores a stall strike
+    # on every participant; strikes accumulate to fail_threshold. 0 disables
+    # (flush wall varies hugely with first-compile costs).
+    mesh_health_stall_threshold: float = 0.0
+    # a dead device re-joins (mesh grows back) only after this many
+    # CONSECUTIVE clean probes — the rejoin hysteresis that stops a flapping
+    # chip from thrashing rebuilds
+    mesh_health_rejoin_probes: int = 3
+    # background probe cadence for dead devices (seconds)
+    mesh_health_probe_interval: float = 2.0
 
 
 @dataclass

@@ -116,6 +116,15 @@ def configure_breaker(**kwargs) -> None:
     BREAKER.configure(**kwargs)
 
 
+def configure_mesh_health(**kwargs) -> None:
+    """Apply `[crypto] mesh_health_*` config (node/node.py): the elastic
+    mesh's per-device scoring thresholds and rejoin hysteresis
+    (parallel/health.py)."""
+    from tendermint_tpu.parallel import health as _mh
+
+    _mh.MESH_HEALTH.configure(**kwargs)
+
+
 def record_backend_rows(backend: str, rows: int) -> None:
     """One (rows, flush) observation on the per-signature-scheme series
     (tendermint_batch_verify_backend_*): every routing site that settles
@@ -1775,19 +1784,57 @@ def _verify_batch_rlc_streamed(
 
 
 def _verify_batch_rlc_sharded_streamed(
-    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    env=None,
 ) -> Optional[np.ndarray]:
     """The planner's multi-chip arm: fixed-bucket chunks stream ACROSS the
     mesh (parallel/sharded.sharded_rlc_stream) — per-shard lane slices via
     prepare_rlc_shards with chunk-multiple padding per shard, per-shard
     device-resident partial accumulation, ONE all_gather at the end. Host
-    prep double-buffers exactly like the single-device arm. Returns the
-    mask, or None -> chunked exact recovery in the caller."""
+    prep double-buffers exactly like the single-device arm.
+
+    Elastic replay (ISSUE 19): a shard/device failure mid-stream feeds the
+    health model, invalidates the mesh cache, and REPLAYS the whole flush
+    from chunk 0 on whatever topology _sharded_env() now offers — the
+    survivor mesh re-preps every chunk (per-shard accumulators died with
+    the old mesh), so the verdict mask is byte-identical to the unfaulted
+    run. Descent is bounded (_MESH_REPLAY_ATTEMPTS); when the mesh is gone
+    the caller takes the single-chip rung. A bad SIGNATURE is not a fault:
+    the combined check returns False without raising, and the exact-mask
+    recovery path handles it, so the PR 16 verified-row memo keeps its
+    never-cache-on-failure semantics through any replay.
+
+    `env` pins one topology (prewarm's survivor warm); pinned calls never
+    replay. Returns the mask, or None -> next rung in the caller."""
+    pinned = env is not None
+    replays = 0
+    for _attempt in range(_MESH_REPLAY_ATTEMPTS):
+        e = env if pinned else _sharded_env()
+        if e is None:
+            return None
+        try:
+            mask = _run_sharded_stream(e, pubkeys, msgs, sigs)
+        except _MeshReplay:
+            if pinned:
+                return None
+            replays += 1
+            continue
+        if mask is not None and replays:
+            LAST_FLUSH_DETAIL["mesh_replays"] = replays
+        return mask
+    return None
+
+
+def _run_sharded_stream(
+    env, pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> Optional[np.ndarray]:
+    """One streamed pass over one mesh topology (see the replay contract
+    above). Raises _MeshReplay on device/mesh errors; returns None only for
+    a failed combined check (bad signature somewhere)."""
     from collections import deque
 
-    env = _sharded_env()
-    if env is None:
-        return None
     nd = env[0]
     run_chunk, finish = env[3]
     n = len(pubkeys)
@@ -1847,13 +1894,28 @@ def _verify_batch_rlc_sharded_streamed(
         while inflight:
             _sync_oldest()
         batch_ok = bool(np.asarray(finish(acc)))
-    except Exception:
+    except Exception as exc:
         import logging
 
+        hm = _mesh_health()
+        if not getattr(exc, "_mesh_scored", False):
+            # surfaced at a host-side sync (np.asarray), outside
+            # sharded.py's guard — score it here (attribution probes or
+            # the exception's own shard/device stamp, parallel/health.py)
+            hm.record_failure(_env_devices(env), exc)
+        if not getattr(exc, "_mesh_attributed", False):
+            # no single device owns this failure: strike the MESH rung of
+            # the breaker (per-backend states) — the single-chip device
+            # path stays armed
+            BREAKER.record_backend_failure("mesh", repr(exc))
+        invalidate_sharded_env()
+        _publish_mesh_health()
         logging.getLogger("tendermint_tpu.crypto.batch").exception(
-            "sharded streamed RLC failed; recovering chunk by chunk"
+            "sharded streamed RLC failed; elastic replay on the surviving "
+            "topology"
         )
-        return None
+        raise _MeshReplay from exc
+    BREAKER.record_backend_success("mesh")
     LAST_FLUSH_DETAIL.update(
         jit_bucket=na_c,
         padding_lanes=len(chunks) * 2 * na_c - (2 * n + len(chunks)),
@@ -1925,12 +1987,20 @@ def _verify_batch_streamed(
 
     tr = _trace.tracer if _trace.tracer.enabled else None
     mask = None
+    sharded_tried = False
     if _sharded_env() is not None:
+        sharded_tried = True
         mask = _verify_batch_rlc_sharded_streamed(pubkeys, msgs, sigs)
         if mask is not None:
             LAST_JAX_PATH[0] = "rlc-sharded-streamed"
             return mask
-    else:
+    # Single-chip streamed rung: either this host was never meshed, or the
+    # mesh fell off the ladder MID-FLUSH (device loss exhausted the replay
+    # attempts / tripped the mesh rung — _sharded_env() is None now). A
+    # sharded attempt that failed with the mesh still standing was a bad
+    # SIGNATURE: skip straight to exact recovery, a single-chip rerun of
+    # the same combined check would just fail again.
+    if not sharded_tried or _sharded_env() is None:
         for attempt in range(2):
             try:
                 if tr is not None:
@@ -2034,14 +2104,124 @@ def _verify_batch_rlc(
 # (observability + tests).
 LAST_JAX_PATH: list = [""]
 
-_SHARDED_RUNNER = None  # cached (n_devices, persig_run, rlc_run)
+_SHARDED_RUNNER = None  # cached ((n_devices, health_generation), env)
+_SHARDED_BUILD_LOCK = threading.Lock()  # non-blocking: vote lane never waits
+_RUNNER_CACHE: dict = {}  # device-key tuple -> env; survives rebuilds, so
+# re-selecting a previously-built topology (rejoin to full mesh, prewarmed
+# survivor half-mesh) reuses its warm jit closures instead of recompiling
+_LAST_MESH_ND = [0]  # previously built mesh size (rebuild telemetry)
+_MESH_REPLAY_ATTEMPTS = 4  # bounded ladder descent per streamed flush
+
+
+class _MeshReplay(Exception):
+    """Internal: a sharded flush died on a device/mesh error; the health
+    model has been fed and the mesh cache invalidated — the caller should
+    replay the flush on whatever topology _sharded_env() now offers."""
+
+
+def _mesh_health():
+    from tendermint_tpu.parallel import health as _mh
+
+    return _mh.MESH_HEALTH
+
+
+def invalidate_sharded_env() -> None:
+    """Drop the cached mesh runner (health-generation change, shard
+    failure): the next _sharded_env() call re-selects the healthy topology.
+    Runner closures persist in _RUNNER_CACHE, so a re-selected shape is a
+    warm dispatch, not a recompile."""
+    global _SHARDED_RUNNER
+    _SHARDED_RUNNER = None
+
+
+def mesh_ladder_state() -> str:
+    """Current degrade-ladder rung: full | survivor | single | host
+    (parallel/health.py; gauge tendermint_tpu_mesh_ladder_state)."""
+    try:
+        import jax
+
+        n_vis = len(jax.devices())
+    except Exception:
+        n_vis = 0
+    cur = _SHARDED_RUNNER
+    mesh_nd = cur[1][0] if cur is not None else 0
+    return _mesh_health().ladder_state(
+        n_vis,
+        mesh_nd,
+        not BREAKER.allow_device(),
+        not BREAKER.allow_backend("mesh"),
+    )
+
+
+def _publish_mesh_health() -> None:
+    """Push per-device health + the ladder rung into mesh telemetry (the
+    /debug/mesh + /debug/verify_stats `mesh.health` block and the
+    tendermint_tpu_mesh_device_health / _ladder_state gauges)."""
+    try:
+        from tendermint_tpu.parallel import telemetry as _mesh_tm
+
+        _mesh_tm.record_mesh_health(_mesh_health().snapshot(), mesh_ladder_state())
+    except Exception:  # observability must never break the verify path
+        pass
+
+
+def _on_mesh_rejoin() -> None:
+    """Health-prober callback: a dead device passed its N clean probes —
+    drop the survivor runner so the next flush rebuilds toward the full
+    mesh, and re-arm the mesh rung."""
+    invalidate_sharded_env()
+    BREAKER.close_backend("mesh")
+    _publish_mesh_health()
+
+
+def _build_sharded_env(devs):
+    """Construct (or fetch warm from _RUNNER_CACHE) the runner tuple for an
+    exact device list."""
+    key = tuple(str(d) for d in devs)
+    env = _RUNNER_CACHE.get(key)
+    if env is None:
+        from tendermint_tpu.parallel.sharded import (
+            make_mesh,
+            sharded_rlc_check,
+            sharded_rlc_stream,
+            sharded_verify,
+        )
+
+        mesh = make_mesh(list(devs), axis_names=("vals",))
+        env = (
+            len(devs),
+            sharded_verify(mesh),
+            sharded_rlc_check(mesh),
+            sharded_rlc_stream(mesh),
+        )
+        _RUNNER_CACHE[key] = env
+    return env
+
+
+def _env_devices(env) -> list:
+    """Reverse-map a runner env to its device strings (health attribution
+    for failures that surface at a host-side sync, outside sharded.py's
+    guard). Unknown envs (test fakes) map to [] — attribution then rides
+    the exception's own shard/device stamp, if any."""
+    for key, v in _RUNNER_CACHE.items():
+        if v is env:
+            return list(key)
+    return []
 
 
 def _sharded_env():
-    """Production multi-chip path: when >1 jax device is visible, shard
-    across a 1D mesh (parallel/sharded.py). Uses the largest power-of-two
-    device count so power-of-two shape buckets always divide evenly.
-    Returns (n_devices, persig_run, rlc_run) or None on single-device hosts."""
+    """Production multi-chip path: when >1 healthy jax device is visible,
+    shard across a 1D mesh (parallel/sharded.py) of the largest
+    power-of-two of the HEALTHY devices (parallel/health.py) — the elastic
+    rung selection: a full mesh while everything is alive, a rebuilt
+    survivor mesh after a device loss, None (-> single-chip fused RLC)
+    when fewer than 2 healthy devices remain or the breaker's "mesh" rung
+    is open. The cache is keyed on (mesh size, health generation), and a
+    rebuild happens behind a NON-BLOCKING lock: a flush arriving mid-
+    rebuild (e.g. the scheduler's vote lane) routes single-chip immediately
+    instead of waiting on mesh construction.
+
+    Returns (n_devices, persig_run, rlc_run, (run_chunk, finish)) or None."""
     global _SHARDED_RUNNER
     knob = os.environ.get("TMTPU_SHARDED", "auto")
     if knob == "0":
@@ -2054,26 +2234,39 @@ def _sharded_env():
         # exposes 8 virtual devices for mesh tests, but routing every
         # verify_batch through shard_map there would just burn compiles.
         return None
-    nd = 1 << (len(devs).bit_length() - 1)  # largest pow2 <= len(devs)
+    if not BREAKER.allow_backend("mesh"):
+        return None
+    hm = _mesh_health()
+    hm.add_rejoin_listener(_on_mesh_rejoin)
+    healthy = hm.healthy_devices(devs)
+    if not healthy:
+        return None
+    nd = 1 << (len(healthy).bit_length() - 1)  # largest pow2 <= healthy
     if nd < 2:
         return None
-    if _SHARDED_RUNNER is not None and _SHARDED_RUNNER[0] == nd:
-        return _SHARDED_RUNNER
-    from tendermint_tpu.parallel.sharded import (
-        make_mesh,
-        sharded_rlc_check,
-        sharded_rlc_stream,
-        sharded_verify,
-    )
+    key = (nd, hm.generation)
+    cur = _SHARDED_RUNNER
+    if cur is not None and cur[0] == key:
+        return cur[1]
+    if not _SHARDED_BUILD_LOCK.acquire(blocking=False):
+        return None  # rebuild in flight: degrade THIS flush, never wait
+    try:
+        cur = _SHARDED_RUNNER
+        if cur is not None and cur[0] == key:
+            return cur[1]
+        t0 = time.perf_counter()
+        env = _build_sharded_env(healthy[:nd])
+        _SHARDED_RUNNER = (key, env)
+        prev = _LAST_MESH_ND[0]
+        _LAST_MESH_ND[0] = nd
+        if prev and prev != nd:
+            from tendermint_tpu.parallel import telemetry as _mesh_tm
 
-    mesh = make_mesh(devs[:nd], axis_names=("vals",))
-    _SHARDED_RUNNER = (
-        nd,
-        sharded_verify(mesh),
-        sharded_rlc_check(mesh),
-        sharded_rlc_stream(mesh),
-    )
-    return _SHARDED_RUNNER
+            _mesh_tm.record_rebuild(prev, nd, time.perf_counter() - t0)
+    finally:
+        _SHARDED_BUILD_LOCK.release()
+    _publish_mesh_health()
+    return env
 
 
 def _sharded_runner():
@@ -2088,14 +2281,17 @@ def _verify_batch_rlc_sharded(
     sharded across the mesh (parallel/sharded.sharded_rlc_check) — each chip
     runs a partial MSM over its lane shard, partial points are all-gathered
     over ICI and summed. ~10x less per-chip work than the sharded per-sig
-    ladder. Returns the mask, or None -> per-sig sharded fallback."""
+    ladder. Returns the mask, or None -> per-sig sharded fallback.
+
+    Elastic (ISSUE 19): a device/mesh error feeds the health model and the
+    flush replays on the survivor topology (host prep — hashing, scalars —
+    is mesh-independent and computed once; only the nd-dependent padding
+    and shard split re-derive per attempt)."""
     from tendermint_tpu.crypto.ed25519_ref import BASE, point_compress
     from tendermint_tpu.parallel.sharded import prepare_rlc_shards
 
-    env = _sharded_env()
-    if env is None:
+    if _sharded_env() is None:
         return None
-    nd, _, rlc_run, _stream = env
     n = len(pubkeys)
     from tendermint_tpu import native
 
@@ -2111,66 +2307,84 @@ def _verify_batch_rlc_sharded(
         )
         zs, w_scalars, u = _rlc_scalars(precheck, s_ints, hk_ints, n)
 
-    # NOTE: no decoded-pubkey cache on this path yet — every height
-    # re-decodes A in-kernel (acceptable: this path only runs on multi-chip
-    # hosts, which this environment cannot exercise beyond the dryrun); a
-    # cached-A sharded variant is the natural next step.
-    na = _lane_bucket(n + 1)
-    while (2 * na) % nd:
-        na += 1
-    # Round the per-shard lane count up to a fused-chunk multiple when the
-    # padding stays modest (<= 25%): each shard then runs the VMEM-resident
-    # fused stage pipeline (ops/pallas_msm.py) instead of the per-level
-    # schedule — e.g. 10k validators on 8 chips pad 20480 -> 24576 lanes
-    # (3x1024 per shard) for the fused tree/prefix/bucket kernels.
-    from tendermint_tpu.ops import msm_jax as _msm
+    for _attempt in range(_MESH_REPLAY_ATTEMPTS):
+        env = _sharded_env()
+        if env is None:
+            return None
+        nd, _, rlc_run, _stream = env
+        # NOTE: no decoded-pubkey cache on this path yet — every height
+        # re-decodes A in-kernel (acceptable: this path only runs on
+        # multi-chip hosts, which this environment cannot exercise beyond
+        # the dryrun); a cached-A sharded variant is the natural next step.
+        na = _lane_bucket(n + 1)
+        while (2 * na) % nd:
+            na += 1
+        # Round the per-shard lane count up to a fused-chunk multiple when
+        # the padding stays modest (<= 25%): each shard then runs the
+        # VMEM-resident fused stage pipeline (ops/pallas_msm.py) instead of
+        # the per-level schedule — e.g. 10k validators on 8 chips pad
+        # 20480 -> 24576 lanes (3x1024 per shard) for the fused
+        # tree/prefix/bucket kernels.
+        from tendermint_tpu.ops import msm_jax as _msm
 
-    if _msm.fused_for_lanes(nd * 1024):
-        target = nd * 1024
-        padded = -(-2 * na // target) * target
-        if 4 * padded <= 5 * (2 * na):
-            na = padded // 2
-    # Mesh telemetry: the padding decision happens HERE (sharded.py only
-    # ever sees padded arrays), so the pad-waste fraction is recorded here.
-    from tendermint_tpu.parallel import telemetry as _mesh_tm
+        if _msm.fused_for_lanes(nd * 1024):
+            target = nd * 1024
+            padded = -(-2 * na // target) * target
+            if 4 * padded <= 5 * (2 * na):
+                na = padded // 2
+        # Mesh telemetry: the padding decision happens HERE (sharded.py
+        # only ever sees padded arrays), so pad waste is recorded here.
+        from tendermint_tpu.parallel import telemetry as _mesh_tm
 
-    _mesh_tm.record_pad(requested_lanes=2 * n + 1, padded_lanes=2 * na)
-    b_enc = np.frombuffer(point_compress(BASE), dtype=np.uint8)
-    pts = np.tile(b_enc, (2 * na, 1))
-    if precheck.any():
-        pts[:n][precheck] = a_rows[precheck]
-        pts[na : na + n][precheck] = r_rows[precheck]
-    if use_native:
-        scalars = np.zeros((2 * na, 32), dtype=np.uint8)
-        scalars[:n] = w_rows
-        scalars[n] = np.frombuffer(
-            ((L - u) % L).to_bytes(32, "little"), dtype=np.uint8
+        _mesh_tm.record_pad(requested_lanes=2 * n + 1, padded_lanes=2 * na)
+        b_enc = np.frombuffer(point_compress(BASE), dtype=np.uint8)
+        pts = np.tile(b_enc, (2 * na, 1))
+        if precheck.any():
+            pts[:n][precheck] = a_rows[precheck]
+            pts[na : na + n][precheck] = r_rows[precheck]
+        if use_native:
+            scalars = np.zeros((2 * na, 32), dtype=np.uint8)
+            scalars[:n] = w_rows
+            scalars[n] = np.frombuffer(
+                ((L - u) % L).to_bytes(32, "little"), dtype=np.uint8
+            )
+            scalars[na : na + n, :16] = z16  # zeroed where ~precheck
+        else:
+            scalars = [0] * (2 * na)
+            scalars[:n] = w_scalars
+            scalars[n] = (L - u) % L
+            scalars[na : na + n] = [
+                zs[i] if precheck[i] else 0 for i in range(n)
+            ]
+
+        try:
+            bok, ok = rlc_run(*prepare_rlc_shards(pts, scalars, nd))
+        except Exception as exc:
+            import logging
+
+            hm = _mesh_health()
+            if not getattr(exc, "_mesh_scored", False):
+                hm.record_failure(_env_devices(env), exc)
+            if not getattr(exc, "_mesh_attributed", False):
+                BREAKER.record_backend_failure("mesh", repr(exc))
+            invalidate_sharded_env()
+            _publish_mesh_health()
+            logging.getLogger("tendermint_tpu.crypto.batch").exception(
+                "sharded RLC failed; elastic replay on the surviving "
+                "topology"
+            )
+            continue
+        BREAKER.record_backend_success("mesh")
+        ok = np.asarray(ok)
+        lanes_ok = (
+            bool(ok[:n][precheck].all() and ok[na : na + n][precheck].all())
+            if precheck.any()
+            else True
         )
-        scalars[na : na + n, :16] = z16  # zeroed where ~precheck
-    else:
-        scalars = [0] * (2 * na)
-        scalars[:n] = w_scalars
-        scalars[n] = (L - u) % L
-        scalars[na : na + n] = [zs[i] if precheck[i] else 0 for i in range(n)]
-
-    try:
-        bok, ok = rlc_run(*prepare_rlc_shards(pts, scalars, nd))
-    except Exception:
-        import logging
-
-        logging.getLogger("tendermint_tpu.crypto.batch").exception(
-            "sharded RLC failed; falling back to sharded per-signature"
-        )
-        return None
-    ok = np.asarray(ok)
-    lanes_ok = (
-        bool(ok[:n][precheck].all() and ok[na : na + n][precheck].all())
-        if precheck.any()
-        else True
-    )
-    if bool(np.asarray(bok)) and lanes_ok:
-        LAST_JAX_PATH[0] = "rlc-sharded"
-        return precheck
+        if bool(np.asarray(bok)) and lanes_ok:
+            LAST_JAX_PATH[0] = "rlc-sharded"
+            return precheck
+        return None  # combined check said no: bad signature, exact recovery
     return None
 
 
@@ -2207,6 +2421,10 @@ def verify_batch_jax(
         # Combined check failed: at least one signature is bad (or an
         # encoding was invalid) — recover the exact per-signature mask.
         LAST_FLUSH_DETAIL["rlc_fallback"] = True
+        # Re-fetch the mesh runner: the RLC attempt above may have rebuilt
+        # the mesh (survivor topology) or lost it entirely — the per-sig
+        # fallback must not dispatch onto a dead mesh captured earlier.
+        sharded = _sharded_runner()
     a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
     t_dev = time.perf_counter()
     try:
@@ -2869,6 +3087,37 @@ def _prewarm_bls() -> None:
     bls_ref.verify(pk, b"prewarm", sig)
 
 
+def _prewarm_survivor_mesh(pk: bytes, msg: bytes, sig: bytes) -> None:
+    """Elastic-mesh satellite (ISSUE 19): pre-build the HALF-mesh runners
+    (the next power-of-two down — the exact topology a single device loss
+    rebuilds to) and push one minimal 2-chunk streamed flush through them.
+    The runners land in _RUNNER_CACHE, which is exactly where a
+    post-failure _sharded_env() rebuild looks first, so the first flush on
+    the survivor mesh is a warm dispatch instead of a fresh XLA compile.
+    Runs in prewarm's background thread; never raises."""
+    try:
+        env = _sharded_env()
+        if env is None or env[0] < 4:
+            return  # a 2-device mesh degrades to single-chip, not half-mesh
+        import jax
+
+        healthy = _mesh_health().healthy_devices(jax.devices())
+        nd2 = env[0] // 2
+        if len(healthy) < nd2:
+            return
+        surv = _build_sharded_env(healthy[:nd2])
+        rows = planner_chunk_rows() + 1
+        _verify_batch_rlc_sharded_streamed(
+            [pk] * rows, [msg] * rows, [sig] * rows, env=surv
+        )
+    except Exception:
+        import logging
+
+        logging.getLogger("tendermint_tpu.crypto.batch").debug(
+            "survivor-mesh prewarm failed", exc_info=True
+        )
+
+
 def prewarm(
     n_vals: int,
     backend: str | None = None,
@@ -2942,6 +3191,9 @@ def prewarm(
         # chunk bucket, so this one warm covers both paths)
         rows = planner_chunk_rows() + 1
         verify_batch_jax([pk] * rows, [msg] * rows, [sig] * rows)
+        # ISSUE 19: also warm the SURVIVOR half-mesh chunk bucket, so the
+        # first post-device-loss flush pays a warm dispatch, not a compile
+        _prewarm_survivor_mesh(pk, msg, sig)
     if pubkeys:
         # decode the real validator keys so consensus's first flush is a
         # cache hit (this is the exact decode steady state amortizes away)

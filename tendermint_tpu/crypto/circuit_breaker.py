@@ -74,6 +74,10 @@ class VerifyCircuitBreaker:
         self._probe_backoff = self.probe_interval_base
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_wakeup = threading.Event()
+        # Per-backend rungs (ISSUE 19): named sub-breakers below the global
+        # device gate — e.g. "mesh" covers the sharded multi-chip path, so a
+        # sick MESH degrades to single-chip while allow_device() stays True.
+        self._backends: dict = {}  # name -> state dict
 
     # -- config / lifecycle -------------------------------------------------
 
@@ -112,6 +116,7 @@ class VerifyCircuitBreaker:
             self._close_locked()
             self._trips.clear()
             self._last_error = None
+            self._backends.clear()
         self._probe_wakeup.set()  # let the probe loop notice and exit now
 
     # -- the hot-path gate --------------------------------------------------
@@ -154,6 +159,126 @@ class VerifyCircuitBreaker:
             self._consec_failures += 1
             if self.state == CLOSED and self._consec_failures >= self.failure_threshold:
                 self._trip_locked("device_error", error)
+
+    # -- per-backend states (ISSUE 19 elastic mesh) -------------------------
+    #
+    # The global CLOSED/OPEN pair above answers "may we touch the device AT
+    # ALL"; these named rungs answer "may we use THIS path on the device".
+    # Opening a backend never opens the global breaker: tripping "mesh"
+    # routes sharded flushes to the single-chip fused path while
+    # allow_device() stays True — the all-or-nothing trip becomes a ladder.
+
+    def _backend_locked(self, name: str) -> dict:
+        st = self._backends.get(name)
+        if st is None:
+            st = self._backends[name] = {
+                "state": CLOSED,
+                "consec_failures": 0,
+                "trips": 0,
+                "last_error": None,
+                "opened_at": None,
+                "backoff": self.probe_interval_base,
+            }
+        return st
+
+    def allow_backend(self, name: str) -> bool:
+        """Cheap per-flush gate for a named backend rung. False while that
+        rung is open; after the rung's backoff elapses it half-opens, so
+        exactly one trial flush re-tests the path (no dedicated prober:
+        the trial IS the probe — its success/failure records below)."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            st = self._backends.get(name)
+            if st is None or st["state"] == CLOSED:
+                return True
+            if st["state"] == HALF_OPEN:
+                return True
+            if (
+                st["opened_at"] is not None
+                and self._clock() - st["opened_at"] >= st["backoff"]
+            ):
+                st["state"] = HALF_OPEN
+                return True
+            return False
+
+    def record_backend_failure(self, name: str, error: str = "") -> bool:
+        """One failure that is attributable to the BACKEND, not to a single
+        device (e.g. an un-attributed mesh flush failure: every per-device
+        probe passed, yet the collective call died). Trips the rung open at
+        the same consecutive-failure threshold as the global breaker; a
+        half-open trial failure re-opens immediately with doubled backoff.
+        Returns True when this call tripped the rung."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            st = self._backend_locked(name)
+            st["last_error"] = error or "backend call failed"
+            st["consec_failures"] += 1
+            tripped = False
+            if st["state"] == HALF_OPEN:
+                st["state"] = OPEN
+                st["opened_at"] = self._clock()
+                st["backoff"] = min(st["backoff"] * 2, self.probe_interval_max)
+                st["consec_failures"] = 0
+            elif (
+                st["state"] == CLOSED
+                and st["consec_failures"] >= self.failure_threshold
+            ):
+                st["state"] = OPEN
+                st["trips"] += 1
+                st["consec_failures"] = 0
+                st["opened_at"] = self._clock()
+                st["backoff"] = self.probe_interval_base
+                tripped = True
+        if tripped:
+            try:
+                self._metrics().breaker_trips.labels(f"backend:{name}").inc()
+            except Exception:
+                pass
+            logger.error(
+                "verify backend %r tripped open: %s — degrading one rung "
+                "(device path itself stays armed)", name, error or "n/a",
+            )
+        return tripped
+
+    def record_backend_success(self, name: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            st = self._backends.get(name)
+            if st is None:
+                return
+            st["consec_failures"] = 0
+            if st["state"] == HALF_OPEN:
+                st["state"] = CLOSED
+                st["opened_at"] = None
+                st["backoff"] = self.probe_interval_base
+                logger.warning("verify backend %r trial passed — re-armed", name)
+
+    def open_backend(self, name: str, error: str = "") -> None:
+        """Force a rung open (the mesh health model uses this when the
+        healthy device count can no longer form a >= 2-chip mesh)."""
+        with self._lock:
+            st = self._backend_locked(name)
+            if st["state"] != OPEN:
+                st["state"] = OPEN
+                st["trips"] += 1
+                st["opened_at"] = self._clock()
+            st["last_error"] = error or st["last_error"]
+
+    def close_backend(self, name: str) -> None:
+        """Re-arm a rung (health prober, after clean probes)."""
+        with self._lock:
+            st = self._backends.get(name)
+            if st is None:
+                return
+            if st["state"] != CLOSED:
+                logger.warning("verify backend %r re-armed", name)
+            st["state"] = CLOSED
+            st["consec_failures"] = 0
+            st["opened_at"] = None
+            st["backoff"] = self.probe_interval_base
 
     # -- state transitions --------------------------------------------------
 
@@ -321,4 +446,18 @@ class VerifyCircuitBreaker:
                     self._probe_backoff if self.state != CLOSED else None
                 ),
                 "last_error": self._last_error,
+                "backends": {
+                    name: {
+                        "state": st["state"],
+                        "consecutive_failures": st["consec_failures"],
+                        "trips": st["trips"],
+                        "open_for_s": (
+                            round(self._clock() - st["opened_at"], 3)
+                            if st["opened_at"] is not None and st["state"] != CLOSED
+                            else None
+                        ),
+                        "last_error": st["last_error"],
+                    }
+                    for name, st in sorted(self._backends.items())
+                },
             }

@@ -732,6 +732,14 @@ class VerifyScheduler:
         from tendermint_tpu.crypto import batch as _batch
 
         out["verified_memo"] = _batch.verified_memo_stats()
+        # Elastic mesh (ISSUE 19): the ladder rung every queued flush will
+        # route through — a scheduler serving from a survivor mesh (or
+        # single-chip after a mesh trip) should say so on the same surface
+        # its lane waits are judged on.
+        try:
+            out["mesh_ladder"] = _batch.mesh_ladder_state()
+        except Exception:
+            out["mesh_ladder"] = None
         return out
 
     def close(self) -> None:

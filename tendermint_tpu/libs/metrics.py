@@ -846,6 +846,25 @@ class MeshMetrics:
             "a foreign host's artifacts misses, never loader failures.",
             ("result",),
         )
+        # Elastic mesh (ISSUE 19): per-device health + degrade ladder.
+        self.device_health = reg.gauge(
+            f"{ns}_device_health",
+            "Per-device mesh health: 1=healthy, 0.5=dead-but-probing-clean "
+            "(mid-rejoin), 0=dead. replace_series'd from the health "
+            "snapshot, so a departed device's series drops instead of "
+            "freezing.",
+            ("device",),
+        )
+        self.rebuilds = reg.counter(
+            f"{ns}_rebuilds_total",
+            "Mesh topology rebuilds (device loss shrank the mesh, or a "
+            "recovered device re-joined after clean probes).",
+        )
+        self.ladder_state = reg.gauge(
+            f"{ns}_ladder_state",
+            "Verification degrade-ladder rung: 0=full mesh, 1=survivor "
+            "mesh, 2=single-chip, 3=host (breaker open).",
+        )
 
 
 class ObservatoryMetrics:

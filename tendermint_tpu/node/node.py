@@ -118,6 +118,18 @@ class Node:
         _batch.configure_verified_memo(
             rows=getattr(config.crypto, "verified_memo_rows", None)
         )
+        # elastic mesh health model (ISSUE 19; same process-global model)
+        _batch.configure_mesh_health(
+            enabled=getattr(config.crypto, "mesh_health_enabled", None),
+            fail_threshold=getattr(config.crypto, "mesh_health_fail_threshold", None),
+            stall_threshold_s=getattr(
+                config.crypto, "mesh_health_stall_threshold", None
+            ),
+            rejoin_probes=getattr(config.crypto, "mesh_health_rejoin_probes", None),
+            probe_interval_s=getattr(
+                config.crypto, "mesh_health_probe_interval", None
+            ),
+        )
         self._owns_priv_validator = False
         if priv_validator is None and config.base.priv_validator_addr:
             # dial the remote signer (reference: node/node.go:658

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tendermint_tpu.libs import forensics as _forensics
 from tendermint_tpu.ops import cache_hardening
 from tendermint_tpu.ops.ed25519_jax import _verify_core, make_ctx, verify_prepared
+from tendermint_tpu.parallel import health as _mesh_health
 from tendermint_tpu.parallel import telemetry as _mesh_tm
 
 # Round 4 bypassed the persistent compile cache for every sharded kernel
@@ -37,6 +38,60 @@ from tendermint_tpu.parallel import telemetry as _mesh_tm
 # atomic tmp+rename writes (ops/cache_hardening.py) the cache is safe to
 # use again — warm sharded processes load their executables in seconds.
 cache_hardening.harden()
+
+
+class ShardFaultError(RuntimeError):
+    """A failure of exactly ONE lane slice of a sharded dispatch, carrying
+    its attribution: the shard index and the device string. Chaos injection
+    (chaos/device.py) raises these from the shard-fault hook below; the
+    health model (parallel/health.py) reads .device/.shard directly instead
+    of probing the whole mesh."""
+
+    def __init__(self, site: str, shard: int, device) -> None:
+        super().__init__(f"shard fault at {site}: shard {shard} ({device})")
+        self.site = site
+        self.shard = int(shard)
+        self.device = str(device)
+
+
+_SHARD_FAULT_HOOK = None  # callable(site: str, devices: list[str]); may raise
+
+
+def set_shard_fault_hook(fn) -> None:
+    """Install (or clear, with None) the chaos shard-fault hook. It runs at
+    every sharded submit site with the participating device strings, so a
+    chaos schedule can kill exactly one lane slice mid-flush."""
+    global _SHARD_FAULT_HOOK
+    _SHARD_FAULT_HOOK = fn
+
+
+def _shard_fault(site: str, devices) -> None:
+    hook = _SHARD_FAULT_HOOK
+    if hook is not None:
+        hook(site, devices)
+
+
+def _guarded(site: str, devices, fn, *args):
+    """Run one sharded dispatch under the elastic-mesh contract: the chaos
+    shard hook fires first (so an injected fault lands on exactly this
+    dispatch), any raise is scored against the per-device health model
+    (stamped ``_mesh_scored`` so callers further up never double-score),
+    and a clean return clears the participants' failure streaks — with the
+    call's wall feeding stall scoring."""
+    t0 = time.perf_counter()
+    try:
+        _shard_fault(site, devices)
+        out = fn(*args)
+    except Exception as e:
+        if not getattr(e, "_mesh_scored", False):
+            _mesh_health.MESH_HEALTH.record_failure(devices, e)
+            try:
+                e._mesh_scored = True
+            except Exception:
+                pass
+        raise
+    _mesh_health.MESH_HEALTH.record_success(devices, time.perf_counter() - t0)
+    return out
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
@@ -133,6 +188,8 @@ def sharded_verify(mesh: Mesh):
             fn = _cache[batch_rank] = jax.jit(_verify)
         return fn
 
+    devices = [str(d) for d in mesh.devices.flat]
+
     def run(a, r, s_bits, h_bits):
         import numpy as np
 
@@ -144,7 +201,12 @@ def sharded_verify(mesh: Mesh):
         # outside even while this thread hangs in the tunnel
         _forensics.beat("mesh_persig_submit")
         t0 = time.perf_counter()
-        out = _for_rank(rank)(a, r, s_bits, h_bits, make_ctx(shard_batch))
+        out = _guarded(
+            "mesh_persig_submit",
+            devices,
+            _for_rank(rank),
+            a, r, s_bits, h_bits, make_ctx(shard_batch),
+        )
         t1 = time.perf_counter()
         _forensics.beat("mesh_persig_finish")
         out = np.asarray(out)
@@ -154,7 +216,7 @@ def sharded_verify(mesh: Mesh):
             shard_lanes=lanes,
             submit_s=t1 - t0,
             finish_s=time.perf_counter() - t1,
-            devices=[str(d) for d in mesh.devices.flat],
+            devices=devices,
         )
         return out
 
@@ -205,6 +267,8 @@ def sharded_commit_step(mesh: Mesh):
             fn = _cache[batch_rank] = jax.jit(_step)
         return fn
 
+    devices = [str(d) for d in mesh.devices.flat]
+
     def step(a, r, s_bits, h_bits, power_planes):
         import numpy as np
 
@@ -213,8 +277,11 @@ def sharded_commit_step(mesh: Mesh):
         lanes = int(np.prod(shard_batch)) if shard_batch else 1
         _forensics.beat("mesh_commit_submit")
         t0 = time.perf_counter()
-        mask, talled, total = _for_rank(rank)(
-            a, r, s_bits, h_bits, power_planes, make_ctx(shard_batch)
+        mask, talled, total = _guarded(
+            "mesh_commit_submit",
+            devices,
+            _for_rank(rank),
+            a, r, s_bits, h_bits, power_planes, make_ctx(shard_batch),
         )
         t1 = time.perf_counter()
 
@@ -229,7 +296,7 @@ def sharded_commit_step(mesh: Mesh):
             shard_lanes=lanes,
             submit_s=t1 - t0,
             finish_s=time.perf_counter() - t1,
-            devices=[str(d) for d in mesh.devices.flat],
+            devices=devices,
             ok=bool(ok),
         )
         return mask, ok
@@ -319,6 +386,8 @@ def sharded_rlc_check(mesh: Mesh):
             )
         return fn
 
+    devices = [str(d) for d in mesh.devices.flat]
+
     def run(pts_bytes, perm, ends):
         import numpy as np
 
@@ -327,7 +396,9 @@ def sharded_rlc_check(mesh: Mesh):
         n_sh = pts_bytes.shape[2]
         _forensics.beat("mesh_rlc_submit")
         t0 = time.perf_counter()
-        bok, ok = _for_lanes(n_sh)(pts_bytes, perm, ends)
+        bok, ok = _guarded(
+            "mesh_rlc_submit", devices, _for_lanes(n_sh), pts_bytes, perm, ends
+        )
         t1 = time.perf_counter()
         _forensics.beat("mesh_rlc_finish")
         bok = np.asarray(bok)
@@ -340,7 +411,7 @@ def sharded_rlc_check(mesh: Mesh):
             finish_s=time.perf_counter() - t1,
             # ONE all_gather of the (4, 20) int32 partial point per device
             all_gather_bytes=ndev * 4 * 20 * 4,
-            devices=[str(d) for d in mesh.devices.flat],
+            devices=devices,
             ok=bool(bok),
         )
         return bok, ok.reshape(-1)
@@ -467,6 +538,8 @@ def sharded_rlc_stream(mesh: Mesh):
         fn = _cache["finish"] = jax.jit(lambda ac: _fin(ac, make_small_ctx()))
         return fn
 
+    devices = [str(d) for d in mesh.devices.flat]
+
     def run_chunk(pts_bytes, perm, ends, acc):
         if pts_bytes.shape[0] != ndev:
             raise ValueError(
@@ -476,23 +549,33 @@ def sharded_rlc_stream(mesh: Mesh):
         _forensics.beat("mesh_rlc_stream_submit")
         t0 = time.perf_counter()
         if acc is None:
-            acc, ok = _chunk_fn(n_sh, False)(pts_bytes, perm, ends)
+            acc, ok = _guarded(
+                "mesh_rlc_stream_submit",
+                devices,
+                _chunk_fn(n_sh, False),
+                pts_bytes, perm, ends,
+            )
         else:
-            acc, ok = _chunk_fn(n_sh, True)(pts_bytes, perm, ends, acc)
+            acc, ok = _guarded(
+                "mesh_rlc_stream_submit",
+                devices,
+                _chunk_fn(n_sh, True),
+                pts_bytes, perm, ends, acc,
+            )
         _mesh_tm.record_flush(
             "rlc_stream_chunk",
             ndev=ndev,
             shard_lanes=n_sh,
             submit_s=time.perf_counter() - t0,
             finish_s=0.0,
-            devices=[str(d) for d in mesh.devices.flat],
+            devices=devices,
         )
         return acc, ok
 
     def finish(acc):
         _forensics.beat("mesh_rlc_stream_finish")
         t0 = time.perf_counter()
-        bok = _finish_fn()(acc)
+        bok = _guarded("mesh_rlc_stream_finish", devices, _finish_fn(), acc)
         _mesh_tm.record_flush(
             "rlc_stream_finish",
             ndev=ndev,
@@ -501,7 +584,7 @@ def sharded_rlc_stream(mesh: Mesh):
             finish_s=0.0,
             # the flush's ONE all_gather: (4, 20) int32 per device
             all_gather_bytes=ndev * 4 * 20 * 4,
-            devices=[str(d) for d in mesh.devices.flat],
+            devices=devices,
         )
         return bok
 

@@ -38,6 +38,11 @@ def _fresh() -> Dict[str, Any]:
         "last_flush": None,
         "last_prep": None,
         "aot_cache": {},  # result -> count (hit / miss / corrupt)
+        # Elastic mesh (ISSUE 19): per-device health + degrade ladder
+        "health": None,  # parallel/health.MeshHealthManager.snapshot()
+        "ladder": None,  # "full" | "survivor" | "single" | "host"
+        "rebuilds": 0,
+        "last_rebuild": None,
     }
 
 
@@ -168,6 +173,64 @@ def record_flush(
         pass
 
 
+def record_rebuild(from_devices: int, to_devices: int, seconds: float) -> None:
+    """One mesh rebuild (crypto/batch._sharded_env): the topology changed
+    size — a device died (shrink) or re-joined after clean probes (grow)."""
+    with _LOCK:
+        _STATS["rebuilds"] += 1
+        _STATS["last_rebuild"] = {
+            "from_devices": int(from_devices),
+            "to_devices": int(to_devices),
+            "seconds": round(seconds, 6),
+            "ts": time.time(),
+        }
+    try:
+        _metrics().rebuilds.inc()
+    except Exception:
+        pass
+    try:
+        from tendermint_tpu.libs.trace import tracer
+
+        if tracer.enabled:
+            tracer.event(
+                "mesh.rebuild",
+                from_devices=int(from_devices),
+                to_devices=int(to_devices),
+                seconds=round(seconds, 6),
+            )
+    except Exception:
+        pass
+
+
+# Encoded ladder rungs for the tendermint_tpu_mesh_ladder_state gauge; keep
+# in sync with parallel/health.LADDER_GAUGE.
+_LADDER_GAUGE = {"full": 0, "survivor": 1, "single": 2, "host": 3}
+
+
+def record_mesh_health(snapshot: dict, ladder: str) -> None:
+    """Per-device health + ladder rung (crypto/batch._publish_mesh_health).
+    The device-health gauge is replace_series'd: a departed device's series
+    DROPS from /metrics instead of freezing at its last value."""
+    with _LOCK:
+        _STATS["health"] = snapshot
+        _STATS["ladder"] = ladder
+    try:
+        m = _metrics()
+        values = {}
+        for dev, st in (snapshot.get("devices") or {}).items():
+            if st.get("state") == "healthy":
+                v = 1.0
+            elif st.get("clean_probes", 0) > 0:
+                v = 0.5  # dead but probing clean: mid-rejoin
+            else:
+                v = 0.0
+            values[(dev,)] = v
+        m.device_health.replace_series(values)
+        m.ladder_state.set(_LADDER_GAUGE.get(ladder, 2))
+    except Exception:
+        pass
+
+
 def record_aot(result: str) -> None:
     """AOT artifact-cache outcome (ops/aot_cache.py): `hit` (deserialized),
     `miss` (fresh export), `corrupt` (deleted + re-exported). Machine-scoped
@@ -197,7 +260,20 @@ def mesh_stats() -> dict:
             "last_prep": dict(_STATS["last_prep"]) if _STATS["last_prep"] else None,
             "last_pad": dict(_STATS.get("last_pad") or {}) or None,
             "aot_cache": dict(_STATS["aot_cache"]),
+            "ladder": _STATS.get("ladder"),
+            "rebuilds": _STATS.get("rebuilds", 0),
+            "last_rebuild": (
+                dict(_STATS["last_rebuild"]) if _STATS.get("last_rebuild") else None
+            ),
         }
+    # Health reads LIVE from the manager (jax-free) so /debug/mesh shows
+    # probe streaks as they advance, not the last pushed snapshot.
+    try:
+        from tendermint_tpu.parallel.health import MESH_HEALTH
+
+        out["health"] = MESH_HEALTH.snapshot()
+    except Exception:
+        out["health"] = _STATS.get("health")
     return out
 
 

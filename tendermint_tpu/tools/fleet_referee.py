@@ -211,6 +211,45 @@ def build_report(
         for role, ent in sorted(by_role.items())
     }
 
+    # elastic-mesh degrade column (ISSUE 19): each dump's `mesh` section
+    # (/debug/mesh) carries the degrade-ladder rung, rebuild count and
+    # per-device health — fold the worst rung fleet-wide so a soak that
+    # silently limped on a survivor mesh (or fell to host-RLC) reads
+    # straight off the report instead of hiding in per-node dumps
+    ladder_rank = {"full": 0, "survivor": 1, "single": 2, "host": 3}
+    mesh_nodes: Dict[str, dict] = {}
+    for dump in dumps:
+        mesh = dump.get("mesh")
+        if not isinstance(mesh, dict) or mesh.get("error"):
+            continue
+        health = mesh.get("health") or {}
+        devices = health.get("devices") or {}
+        dead = sorted(
+            k for k, st in devices.items()
+            if isinstance(st, dict) and st.get("state") == "dead"
+        )
+        ladder = mesh.get("ladder")
+        rebuilds = mesh.get("rebuilds") or 0
+        if ladder is None and not rebuilds and not dead:
+            continue  # node never exercised the elastic mesh: no column
+        mesh_nodes[obs._node_label(dump)] = {
+            "ladder": ladder,
+            "rebuilds": int(rebuilds),
+            "dead_devices": dead,
+        }
+    mesh_degrade = None
+    if mesh_nodes:
+        worst = max(
+            (e["ladder"] for e in mesh_nodes.values() if e["ladder"]),
+            key=lambda l: ladder_rank.get(l, 0),
+            default=None,
+        )
+        mesh_degrade = {
+            "worst_ladder": worst,
+            "rebuilds_total": sum(e["rebuilds"] for e in mesh_nodes.values()),
+            "nodes": dict(sorted(mesh_nodes.items())),
+        }
+
     # fleet-wide terminal accounting (delivered/rejected/evicted/expired)
     terminals: Dict[str, int] = {}
     for terms in (merged.get("tx_terminals") or {}).values():
@@ -250,6 +289,7 @@ def build_report(
         "role_slo": role_slo,
         "slo_any_tripped": merged["slo_any_tripped"],
         "waterfall": waterfall,
+        "mesh_degrade": mesh_degrade,
         "terminals": terminals,
         "slowest_link_counts": merged["slowest_link_counts"],
         "worst_offender": merged["worst_offender"],
@@ -344,11 +384,21 @@ def render_markdown(report: dict) -> str:
         f"{wf['heights_merged']} heights merged; per-node appearance counts:"
     )
     lines.append("")
-    lines.append("| node | role | heights covered |")
-    lines.append("|---|---|---|")
+    lines.append("| node | role | heights covered | mesh degrade |")
+    lines.append("|---|---|---|---|")
     roles = report.get("roles") or {}
+    mesh_nodes = (report.get("mesh_degrade") or {}).get("nodes") or {}
     for label, count in wf["per_node"].items():
-        lines.append(f"| {label} | {roles.get(label, '?')} | {count} |")
+        me = mesh_nodes.get(label)
+        if me:
+            mesh_cell = f"{me.get('ladder') or '?'}·{me.get('rebuilds', 0)}rb"
+            if me.get("dead_devices"):
+                mesh_cell += f"·{len(me['dead_devices'])}dead"
+        else:
+            mesh_cell = "—"
+        lines.append(
+            f"| {label} | {roles.get(label, '?')} | {count} | {mesh_cell} |"
+        )
     if wf["uncovered"]:
         lines.append("")
         lines.append(
@@ -356,6 +406,18 @@ def render_markdown(report: dict) -> str:
             f"{', '.join(wf['uncovered'])}"
         )
     lines.append("")
+
+    md = report.get("mesh_degrade")
+    if md:
+        lines.append("## Elastic mesh degrade")
+        lines.append("")
+        worst = md.get("worst_ladder")
+        mark = "**" if worst and worst != "full" else ""
+        lines.append(
+            f"worst ladder rung: {mark}{worst or '?'}{mark} · "
+            f"{md.get('rebuilds_total', 0)} mesh rebuild(s) fleet-wide"
+        )
+        lines.append("")
 
     lines.append("## Terminal outcomes (fleet-wide)")
     lines.append("")

@@ -88,6 +88,12 @@ def _scenario_speedups(extra: dict) -> Dict[str, Any]:
         # behind device/MSM work for the flush the scenario timed
         if isinstance(res.get("prep_wall_hidden"), (int, float)):
             entry["prep_hidden"] = res["prep_wall_hidden"]
+        # elastic-mesh column (ISSUE 19): survivor-mesh throughput as a
+        # fraction of the full mesh's, plus the final ladder rung
+        if isinstance(res.get("degrade_ratio"), (int, float)):
+            entry["degrade_ratio"] = res["degrade_ratio"]
+        if isinstance(res.get("mesh_ladder"), str):
+            entry["mesh_ladder"] = res["mesh_ladder"]
         if isinstance(res.get("sigs_per_sec"), (int, float)):
             entry["sigs_per_sec"] = res["sigs_per_sec"]
         if res.get("degraded"):
@@ -119,6 +125,7 @@ def parse_bench(path: str) -> dict:
         "scenarios": {},
         "fleet_gate": None,
         "fleet_gate_missing": True,
+        "mesh_degrade": None,
     }
     if doc is None or "_load_error" in (doc or {}):
         row["lost"] = True
@@ -164,6 +171,20 @@ def parse_bench(path: str) -> dict:
             "violations": fs.get("safety_violations"),
         }
         row["fleet_gate_missing"] = False
+    # mesh-degrade column (ISSUE 19): rounds that ran the `mesh_failover`
+    # scenario carry the survivor/full throughput ratio, the final ladder
+    # rung, the rebuild wall and the lost-verdict count; rounds that
+    # didn't show "—" (a gap, not a pass)
+    mf = extra.get("mesh_failover")
+    if isinstance(mf, dict) and (
+        mf.get("degrade_ratio") is not None or mf.get("mesh_ladder")
+    ):
+        row["mesh_degrade"] = {
+            "ratio": mf.get("degrade_ratio"),
+            "ladder": mf.get("mesh_ladder"),
+            "rebuild_s": mf.get("rebuild_s"),
+            "lost_verdicts": (mf.get("during") or {}).get("lost_verdicts"),
+        }
     # a parsed round that carries NEITHER the headline metric nor a
     # headline scenario datapoint lost the trajectory point — flag it
     # explicitly instead of leaving a silent gap in the matrix
@@ -311,8 +332,8 @@ def render_markdown(ledger: dict) -> str:
         "",
         "## Bench rounds",
         "",
-        "| round | metric | value | speedup | prep hidden | fleet gate | host | status |",
-        "|---:|---|---:|---:|---:|---|---|---|",
+        "| round | metric | value | speedup | prep hidden | fleet gate | mesh degrade | host | status |",
+        "|---:|---|---:|---:|---:|---|---|---|---|",
     ]
     for r in ledger["bench"]:
         if r["lost"]:
@@ -346,6 +367,17 @@ def render_markdown(ledger: dict) -> str:
             )
         else:
             fleet = "missing"
+        md = r.get("mesh_degrade")
+        if md:
+            ratio = md.get("ratio")
+            mesh = (
+                f"{ratio:.2f}×" if isinstance(ratio, (int, float)) else "?"
+            ) + f"·{md.get('ladder') or '?'}"
+            lost = md.get("lost_verdicts")
+            if lost:  # nonzero lost verdicts is a failover BUG — shout
+                mesh += f"·**{lost} lost**"
+        else:
+            mesh = "—"
         host = r["fingerprint"] or "—"
         if r.get("versions"):
             host += f" ({_fmt_versions(r['versions'])})"
@@ -356,7 +388,7 @@ def render_markdown(ledger: dict) -> str:
         )
         lines.append(
             f"| {_round_label(r)} | {r['metric'] or '—'} | {value} "
-            f"| {speed} | {hidden} | {fleet} | {host} | {status} |"
+            f"| {speed} | {hidden} | {fleet} | {mesh} | {host} | {status} |"
         )
     lines += ["", "### Per-scenario speedups", ""]
     scen_names: List[str] = []

@@ -1,0 +1,663 @@
+"""Elastic mesh (ISSUE 19) — device-loss-tolerant sharded verification.
+
+Tier-1 contract tests for the health-ranked degrade ladder on a VIRTUAL
+8-device mesh (zero real TPUs): the sharded streamed arm runs through
+host-twin runners (tests/test_flush_planner.py) wrapped in the REAL
+parallel/sharded._guarded dispatch guard, so chaos shard faults, health
+scoring and the breaker's per-backend rungs engage exactly as on a
+multi-chip host. Pinned here:
+
+- a shard fault at EVERY chunk boundary (each guarded submit site and the
+  finish fold) replays the flush and yields a byte-identical verdict mask;
+- a device lost mid-stream is struck to DEAD at the fail threshold, the
+  flush replays on the rebuilt survivor mesh (byte-identical), and later
+  flushes stay SHARDED on the survivors — never CPU-degraded;
+- a bad signature is NOT a fault: no health strikes, exact-mask recovery;
+- an un-attributable mesh failure strikes the breaker's "mesh" rung only —
+  the ladder descends to the single-chip streamed path, no device dies;
+- rejoin needs N CONSECUTIVE clean probes (a failed probe mid-probation
+  resets the streak — no flap), and rejoining re-arms the full mesh;
+- a mesh rebuild never blocks a concurrent flush (the scheduler's vote
+  lane routes single-chip immediately instead of waiting on the lock);
+- the whole kill/replay/rejoin drill is replayable from one seed;
+- the chaos schedule + LocalChaosNet adapters cover the new shard-level
+  fault kinds, and /debug/mesh telemetry carries health + rebuilds.
+"""
+
+import os
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("TMTPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.chaos.device import DeviceFaultInjector
+from tendermint_tpu.chaos.harness import LocalChaosNet
+from tendermint_tpu.chaos.schedule import ChaosSchedule, LEVEL_BY_KIND
+from tendermint_tpu.crypto import batch
+from tendermint_tpu.parallel import health, sharded
+from tendermint_tpu.parallel import telemetry as mesh_tm
+
+from tests.test_flush_planner import (
+    _fake_mesh_env,
+    _install_host_twins,
+    _signed_rows,
+)
+
+DEVKEYS = [f"FakeTPU(id={i})" for i in range(8)]
+
+
+@pytest.fixture
+def planner(monkeypatch):
+    # same geometry as tests/test_flush_planner.py: 31 rows per chunk
+    monkeypatch.setattr(batch, "RLC_MIN", 8)
+    prev = batch.planner_budget()
+    batch.configure_planner(max_flush_lanes=64)
+    yield 31
+    batch.configure_planner(max_flush_lanes=prev)
+    batch.set_device_fault_hook(None)
+
+
+class _ElasticMesh:
+    """Test double for batch._sharded_env: the REAL elastic rung selection
+    (breaker "mesh" gate -> healthy filter -> largest power-of-two) over 8
+    fake device keys, with host-twin runners wrapped in sharded._guarded so
+    fault injection and health scoring ride the production dispatch path."""
+
+    def __init__(self, devices=DEVKEYS):
+        self.devices = list(devices)
+        self._cache = {}
+        self.builds = []  # mesh sizes built, in order (rebuild witness)
+
+    def env_for(self, devs):
+        key = tuple(devs)
+        env = self._cache.get(key)
+        if env is None:
+            nd = len(devs)
+            base_run, base_fin = _fake_mesh_env(nd)[3]
+
+            def run_chunk(pts, perm, ends, acc, _d=list(devs), _r=base_run):
+                return sharded._guarded(
+                    "mesh_rlc_stream_submit", _d, _r, pts, perm, ends, acc
+                )
+
+            def finish(acc, _d=list(devs), _f=base_fin):
+                return sharded._guarded("mesh_rlc_stream_finish", _d, _f, acc)
+
+            env = (nd, None, None, (run_chunk, finish))
+            self._cache[key] = env
+            self.builds.append(nd)
+        return env
+
+    def __call__(self):
+        if not batch.BREAKER.allow_backend("mesh"):
+            return None
+        healthy = [
+            str(d) for d in health.MESH_HEALTH.healthy_devices(self.devices)
+        ]
+        if not healthy:
+            return None
+        nd = 1 << (len(healthy).bit_length() - 1)
+        if nd < 2:
+            return None
+        return self.env_for(healthy[:nd])
+
+
+@pytest.fixture
+def elastic(planner, monkeypatch):
+    hm = health.MESH_HEALTH
+    hm.reset()
+    hm.configure(
+        enabled=True, fail_threshold=2, stall_threshold_s=0.0, rejoin_probes=3
+    )
+    # The default probe resolves keys against jax.devices() — fake keys
+    # would ALWAYS fail it, mis-attributing every collective failure. An
+    # always-pass probe leaves attribution to ShardFaultError stamps and
+    # the chaos probe intercept, matching a healthy virtual mesh.
+    hm.set_probe(lambda key: None)
+    saved_spawn = hm._spawn_probe_thread
+    hm._spawn_probe_thread = False
+    prev_thr = batch.BREAKER.failure_threshold
+    batch.BREAKER.reset()
+    batch.BREAKER.configure(failure_threshold=3)
+    batch._SHARDED_RUNNER = None
+    em = _ElasticMesh()
+    monkeypatch.setattr(batch, "_sharded_env", em)
+    yield em
+    sharded.set_shard_fault_hook(None)
+    hm.set_probe_intercept(None)
+    hm.set_probe(None)
+    hm.reset()
+    hm._spawn_probe_thread = saved_spawn
+    batch.BREAKER.reset()
+    batch.BREAKER.configure(failure_threshold=prev_thr)
+    batch._SHARDED_RUNNER = None
+
+
+# ---------------------------------------------------------------------------
+# Replay: byte-identical masks through faults at every chunk boundary.
+
+
+@pytest.mark.parametrize(
+    "fault_at", [0, 1, 2, 3], ids=["submit0", "submit1", "submit2", "finish"]
+)
+def test_shard_fault_at_every_chunk_boundary_byte_identical(
+    elastic, monkeypatch, fault_at
+):
+    """93 rows = 3 chunks -> 4 guarded dispatch sites (3 submits + the
+    finish fold). A one-shot shard fault at EACH site replays the whole
+    flush and the mask stays byte-identical to the unfaulted run."""
+    _install_host_twins(monkeypatch)
+    pks, msgs, sigs = _signed_rows(93)
+    baseline = batch._verify_batch_streamed(pks, msgs, sigs)
+    assert batch.LAST_JAX_PATH[0] == "rlc-sharded-streamed"
+    assert baseline.all()
+
+    calls = [0]
+
+    def hook(site, devices):
+        k = calls[0]
+        calls[0] += 1
+        if k == fault_at:
+            raise sharded.ShardFaultError(site, 2, devices[2])
+
+    sharded.set_shard_fault_hook(hook)
+    mask = batch._verify_batch_streamed(pks, msgs, sigs)
+    sharded.set_shard_fault_hook(None)
+
+    assert mask.tobytes() == baseline.tobytes()
+    assert batch.LAST_JAX_PATH[0] == "rlc-sharded-streamed"
+    assert batch.LAST_FLUSH_DETAIL.get("mesh_replays") == 1
+    # one strike < fail_threshold(2): the device stays healthy, the clean
+    # replay wiped its consecutive-failure count — full mesh, no rebuild
+    dh = health.MESH_HEALTH.snapshot()["devices"][DEVKEYS[2]]
+    assert dh["state"] == "healthy"
+    assert dh["consec_failures"] == 0 and dh["failures_total"] == 1
+    assert elastic.builds == [8]
+
+
+def test_device_lost_mid_stream_replays_on_survivor_mesh(elastic, monkeypatch):
+    """The acceptance drill's core: kill 1 of 8 virtual devices mid-stream.
+    Two strikes mark it DEAD, the flush replays on the rebuilt 4-device
+    survivor mesh byte-identical, and SUBSEQUENT flushes stay sharded."""
+    _install_host_twins(monkeypatch)
+    inj = DeviceFaultInjector().install()
+    try:
+        pks, msgs, sigs = _signed_rows(93)
+        baseline = batch._verify_batch_streamed(pks, msgs, sigs)
+        assert batch.LAST_JAX_PATH[0] == "rlc-sharded-streamed"
+
+        inj.arm_device_lost(7)  # index -> resolved at the next dispatch
+        mask = batch._verify_batch_streamed(pks, msgs, sigs)
+        assert mask.tobytes() == baseline.tobytes()
+        assert mask.all()  # zero lost verdicts
+        assert batch.LAST_JAX_PATH[0] == "rlc-sharded-streamed"
+        # strike 1 (replay on full mesh), strike 2 -> DEAD (replay on
+        # survivors): exactly two replays, one survivor rebuild
+        assert batch.LAST_FLUSH_DETAIL.get("mesh_replays") == 2
+        assert health.MESH_HEALTH.dead_count() == 1
+        snap = health.MESH_HEALTH.snapshot()
+        assert snap["devices"][DEVKEYS[7]]["state"] == "dead"
+        assert elastic.builds == [8, 4]
+
+        # steady state after the loss: sharded on the survivor mesh (the
+        # ladder's second rung), NOT single-chip or CPU
+        mask2 = batch._verify_batch_streamed(pks, msgs, sigs)
+        assert mask2.tobytes() == baseline.tobytes()
+        assert batch.LAST_JAX_PATH[0] == "rlc-sharded-streamed"
+        assert elastic()[0] == 4
+        assert (
+            health.MESH_HEALTH.ladder_state(8, 4, False, False) == "survivor"
+        )
+    finally:
+        inj.uninstall()
+        inj.heal()
+
+
+def test_bad_signature_is_not_a_mesh_fault(elastic, monkeypatch):
+    """The never-cache-on-failure contract (PR 16 memo) survives the
+    elastic arm: a bad signature makes the combined check return False
+    WITHOUT raising — no health strikes, no breaker strikes, and the
+    exact-mask recovery equals the CPU referee."""
+    _install_host_twins(monkeypatch)
+    pks, msgs, sigs = _signed_rows(93)
+    sigs = list(sigs)
+    sigs[31] = sigs[31][:32] + (1).to_bytes(32, "little")
+    cpu = batch.verify_batch_cpu(pks, msgs, sigs)
+    mask = batch._verify_batch_streamed(pks, msgs, sigs)
+    assert mask.tobytes() == cpu.tobytes()
+    assert not mask[31] and mask.sum() == 92
+    assert batch.LAST_JAX_PATH[0] == "rlc-streamed-recovery"
+    assert health.MESH_HEALTH.dead_count() == 0
+    snap = health.MESH_HEALTH.snapshot()
+    assert all(d["failures_total"] == 0 for d in snap["devices"].values())
+    # the clean sharded pass recorded a backend success, never a strike
+    b = batch.BREAKER.snapshot()["backends"].get("mesh")
+    assert b is None or (
+        b["state"] == "closed" and b["consecutive_failures"] == 0
+    )
+
+
+def test_unattributed_failure_strikes_mesh_rung_descends_single_chip(
+    elastic, monkeypatch
+):
+    """A collective failure no probe can pin on one device must NOT kill
+    devices: it strikes the breaker's "mesh" rung, which opens at the
+    threshold, and the SAME flush completes on the single-chip streamed
+    rung — one step down the ladder, device path still armed."""
+    _install_host_twins(monkeypatch)
+    pks, msgs, sigs = _signed_rows(93)
+
+    def hook(site, devices):
+        raise RuntimeError("ICI collective timeout")
+
+    sharded.set_shard_fault_hook(hook)
+    mask = batch._verify_batch_streamed(pks, msgs, sigs)
+    sharded.set_shard_fault_hook(None)
+
+    assert mask.all()
+    assert batch.LAST_JAX_PATH[0] == "rlc-streamed"
+    assert health.MESH_HEALTH.dead_count() == 0
+    b = batch.BREAKER.snapshot()["backends"]["mesh"]
+    assert b["state"] in ("open", "half_open") and b["trips"] == 1
+    assert batch.BREAKER.allow_device()  # global gate untouched
+    assert health.MESH_HEALTH.ladder_state(8, 0, False, True) == "single"
+    # re-arming the rung restores the sharded path immediately
+    batch.BREAKER.close_backend("mesh")
+    assert elastic() is not None
+    mask2 = batch._verify_batch_streamed(pks, msgs, sigs)
+    assert mask2.tobytes() == mask.tobytes()
+    assert batch.LAST_JAX_PATH[0] == "rlc-sharded-streamed"
+
+
+def test_pinned_env_never_replays(elastic, monkeypatch):
+    """Prewarm pins a topology (env=...): a fault during a pinned flush
+    returns None after ONE attempt instead of replaying — warmup must
+    never fight the live ladder for the mesh."""
+    _install_host_twins(monkeypatch)
+    pks, msgs, sigs = _signed_rows(93)
+    env = elastic.env_for(DEVKEYS[:4])
+    fired = [0]
+
+    def hook(site, devices):
+        fired[0] += 1
+        raise sharded.ShardFaultError(site, 0, devices[0])
+
+    sharded.set_shard_fault_hook(hook)
+    out = batch._verify_batch_rlc_sharded_streamed(pks, msgs, sigs, env=env)
+    sharded.set_shard_fault_hook(None)
+    assert out is None
+    assert fired[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# Rejoin hysteresis + ladder semantics.
+
+
+def test_rejoin_only_after_consecutive_clean_probes(elastic):
+    """Rejoin needs `rejoin_probes` CONSECUTIVE clean probes; a failed
+    probe mid-probation resets the streak (hysteresis — no flap), and the
+    rejoin bumps the generation so the full mesh is re-selected."""
+    hm = health.MESH_HEALTH
+    inj = DeviceFaultInjector().install()
+    rejoined = []
+    hm.add_rejoin_listener(lambda: rejoined.append(True))
+    try:
+        inj.arm_device_lost(DEVKEYS[5])
+        hm.mark_device_lost(DEVKEYS[5])
+        assert hm.dead_count() == 1
+        assert elastic()[0] == 4  # survivor rung while dead
+
+        for _ in range(4):  # probes fail while the device is lost
+            assert not hm.probe_round()
+        assert hm.dead_count() == 1
+
+        inj.revive_device(DEVKEYS[5])
+        assert not hm.probe_round()  # clean streak: 1
+        assert not hm.probe_round()  # clean streak: 2
+        # relapse mid-probation: the streak must reset to zero
+        inj.arm_device_lost(DEVKEYS[5])
+        assert not hm.probe_round()
+        inj.revive_device(DEVKEYS[5])
+        assert not hm.probe_round()  # 1
+        assert not hm.probe_round()  # 2
+        assert hm.dead_count() == 1  # still dead: only 2 consecutive
+        assert hm.probe_round()  # 3rd consecutive clean -> rejoin
+        assert hm.dead_count() == 0
+        assert rejoined  # listener fired (batch drops the stale runner)
+        assert elastic()[0] == 8  # full mesh re-selected
+        assert health.MESH_HEALTH.ladder_state(8, 8, False, False) == "full"
+    finally:
+        inj.uninstall()
+        inj.heal()
+
+
+def test_ladder_state_monotone_mapping(elastic):
+    """The rung name is a pure function of (dead set, mesh size, breaker
+    gates) and the gauge encoding is monotone in degradation depth."""
+    hm = health.MESH_HEALTH
+    seq = [
+        hm.ladder_state(8, 8, False, False),  # everything healthy
+    ]
+    hm.mark_device_lost(DEVKEYS[3])
+    seq.append(hm.ladder_state(8, 4, False, False))  # survivor mesh
+    seq.append(hm.ladder_state(8, 4, False, True))  # mesh rung open
+    seq.append(hm.ladder_state(8, 1, False, False))  # < 2 chips
+    seq.append(hm.ladder_state(8, 8, True, True))  # device gate open
+    assert seq == ["full", "survivor", "single", "single", "host"]
+    gauges = [health.LADDER_GAUGE[s] for s in seq]
+    assert gauges == sorted(gauges)  # monotone descent
+    assert health.LADDER_GAUGE == mesh_tm._LADDER_GAUGE  # metrics in sync
+
+
+def test_stall_strikes_reset_on_fast_flush(elastic):
+    """Stall scoring has the same hysteresis: one slow collective call
+    strikes every participant, but a following fast call clears the
+    strikes — a single straggle never accumulates into a kill."""
+    hm = health.MESH_HEALTH
+    hm.configure(stall_threshold_s=0.05)
+    hm.record_success(DEVKEYS, elapsed_s=0.2)  # stalled
+    snap = hm.snapshot()["devices"]
+    assert all(d["stall_strikes"] == 1 for d in snap.values())
+    assert hm.dead_count() == 0
+    hm.record_success(DEVKEYS, elapsed_s=0.001)  # fast: strikes reset
+    snap = hm.snapshot()["devices"]
+    assert all(d["stall_strikes"] == 0 for d in snap.values())
+    # two CONSECUTIVE stalls do kill (fail_threshold=2)
+    hm.record_success(DEVKEYS, elapsed_s=0.2)
+    hm.record_success(DEVKEYS, elapsed_s=0.2)
+    assert hm.dead_count() == len(DEVKEYS)
+
+
+# ---------------------------------------------------------------------------
+# The rebuild lock and the vote lane.
+
+
+def test_rebuild_never_blocks_vote_lane(monkeypatch):
+    """A flush arriving while another thread holds the mesh-build lock
+    must degrade IMMEDIATELY (returns None -> single-chip), never wait on
+    mesh construction — the scheduler's vote lane SLO does not pay for a
+    rebuild."""
+    import jax
+
+    class _FakeDev:
+        platform = "tpu"
+
+        def __init__(self, i):
+            self.i = i
+
+        def __str__(self):
+            return f"FakeTPU(id={self.i})"
+
+    hm = health.MESH_HEALTH
+    hm.reset()
+    saved_nd = batch._LAST_MESH_ND[0]
+    monkeypatch.setenv("TMTPU_SHARDED", "1")
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [_FakeDev(i) for i in range(8)])
+    batch.BREAKER.reset()
+    batch._SHARDED_RUNNER = None
+
+    gate = threading.Event()
+    building = threading.Event()
+    sentinel = (8, None, None, (None, None))
+
+    def slow_build(devs):
+        building.set()
+        assert gate.wait(5)
+        return sentinel
+
+    monkeypatch.setattr(batch, "_build_sharded_env", slow_build)
+    results = []
+    t = threading.Thread(target=lambda: results.append(batch._sharded_env()))
+    t.start()
+    try:
+        assert building.wait(5)
+        t0 = time.perf_counter()
+        assert batch._sharded_env() is None  # vote lane: no wait
+        assert time.perf_counter() - t0 < 0.5
+    finally:
+        gate.set()
+        t.join(5)
+    assert results == [sentinel]
+    assert batch._sharded_env() is sentinel  # warm after the rebuild
+    batch._SHARDED_RUNNER = None
+    batch._LAST_MESH_ND[0] = saved_nd
+    hm.reset()
+
+
+# ---------------------------------------------------------------------------
+# Per-backend breaker rungs.
+
+
+def test_backend_rung_trip_half_open_trial_cycle():
+    """Unit contract of the "mesh" rung under a fake clock: trip at the
+    threshold, half-open after the backoff (the next flush IS the trial),
+    a failed trial re-opens with doubled backoff, a clean trial closes."""
+    from tendermint_tpu.crypto.circuit_breaker import VerifyCircuitBreaker
+
+    now = [0.0]
+    br = VerifyCircuitBreaker(
+        failure_threshold=3,
+        probe_interval_base=1.0,
+        probe_interval_max=8.0,
+        clock=lambda: now[0],
+        spawn_probe_thread=False,
+    )
+    assert br.allow_backend("mesh")
+    assert not br.record_backend_failure("mesh", "e1")
+    assert not br.record_backend_failure("mesh", "e2")
+    assert br.record_backend_failure("mesh", "e3")  # tripped open
+    assert not br.allow_backend("mesh")
+    assert br.allow_device()  # the rung never opens the global gate
+
+    now[0] = 1.0  # backoff elapsed -> half-open trial allowed
+    assert br.allow_backend("mesh")
+    br.record_backend_failure("mesh", "trial failed")
+    assert not br.allow_backend("mesh")
+    now[0] = 2.5  # doubled backoff (2.0) not yet elapsed
+    assert not br.allow_backend("mesh")
+    now[0] = 3.1
+    assert br.allow_backend("mesh")  # second trial
+    br.record_backend_success("mesh")
+    assert br.allow_backend("mesh")
+    snap = br.snapshot()["backends"]["mesh"]
+    assert snap["state"] == "closed" and snap["trips"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Seeded drill: the whole kill/replay/rejoin episode replays from one seed.
+
+
+def test_seeded_device_loss_drill_replayable(elastic, monkeypatch):
+    """ISSUE 19 acceptance: rng(seed) picks the victim; the mid-stream
+    kill, survivor replay, rejoin and re-expansion produce the identical
+    transcript on a second run from the same seed."""
+    _install_host_twins(monkeypatch)
+    pks, msgs, sigs = _signed_rows(93)
+    hm = health.MESH_HEALTH
+
+    def drill(seed):
+        hm.reset()
+        batch.BREAKER.reset()
+        em = _ElasticMesh()
+        monkeypatch.setattr(batch, "_sharded_env", em)
+        inj = DeviceFaultInjector().install()
+        try:
+            rng = random.Random(seed)
+            victim = rng.randrange(8)
+            baseline = batch._verify_batch_streamed(pks, msgs, sigs)
+            inj.arm_device_lost(victim)
+            during = batch._verify_batch_streamed(pks, msgs, sigs)
+            transcript = [
+                during.tobytes() == baseline.tobytes(),
+                batch.LAST_FLUSH_DETAIL.get("mesh_replays"),
+                tuple(sorted(
+                    k
+                    for k, d in hm.snapshot()["devices"].items()
+                    if d["state"] == "dead"
+                )),
+                tuple(em.builds),
+                batch.LAST_JAX_PATH[0],
+            ]
+            inj.revive_device(victim)
+            rounds = 0
+            while hm.dead_count() and rounds < 16:
+                hm.probe_round()
+                rounds += 1
+            after = batch._verify_batch_streamed(pks, msgs, sigs)
+            transcript += [
+                rounds,
+                after.tobytes() == baseline.tobytes(),
+                em()[0],
+                hm.ladder_state(8, em()[0], False, False),
+            ]
+            return transcript
+        finally:
+            inj.uninstall()
+            inj.heal()
+
+    t1 = drill(0xE1A)
+    t2 = drill(0xE1A)
+    assert t1 == t2
+    # and the drill itself met the bar: byte-identical under fire, two
+    # replays, one dead device, survivor rebuild, rejoin back to full
+    assert t1[0] is True and t1[1] == 2 and len(t1[2]) == 1
+    assert tuple(t1[3]) == (8, 4)
+    assert t1[4] == "rlc-sharded-streamed"
+    assert t1[5] == 3  # rejoin_probes clean rounds
+    assert t1[6] is True and t1[7] == 8 and t1[8] == "full"
+
+
+# ---------------------------------------------------------------------------
+# Chaos surface: schedule kinds + LocalChaosNet adapters.
+
+
+def test_chaos_schedule_mesh_kinds_roundtrip():
+    sch = ChaosSchedule.generate(
+        7,
+        4,
+        episodes=12,
+        kinds=("shard_error", "shard_hang", "device_lost"),
+        mesh_devices=8,
+    )
+    assert len(sch) >= 12
+    seen = set()
+    lost, revived = [], []
+    for ev in sch:
+        assert ev.level == "device"
+        seen.add(ev.kind)
+        p = ev.param_dict()
+        if ev.kind in ("shard_error", "shard_hang"):
+            assert 0 <= p["shard"] < 8
+        if ev.kind == "shard_hang":
+            assert 0.0 < p["seconds"] <= 0.3
+        if ev.kind == "device_lost":
+            lost.append((ev.at, p["device"]))
+        if ev.kind == "device_revive":
+            revived.append((ev.at, p["device"]))
+    assert seen <= {"shard_error", "shard_hang", "device_lost", "device_revive"}
+    # every loss is an EPISODE: a later revive of the same device
+    assert len(lost) == len(revived)
+    for (t0, dev), (t1, rdev) in zip(lost, revived):
+        assert rdev == dev and t1 > t0
+    # deterministic + serializable: same seed -> same schedule, JSON
+    # roundtrip preserves the fingerprint (the reproducibility pin)
+    assert sch == ChaosSchedule.generate(
+        7, 4, episodes=12,
+        kinds=("shard_error", "shard_hang", "device_lost"), mesh_devices=8,
+    )
+    back = ChaosSchedule.from_json(sch.to_json())
+    assert back == sch and back.fingerprint() == sch.fingerprint()
+    for kind in ("shard_error", "shard_hang", "device_lost", "device_revive"):
+        assert LEVEL_BY_KIND[kind] == "device"
+
+
+def test_local_chaos_net_shard_adapters_delegate_to_injector():
+    net = LocalChaosNet(make_node=lambda i: None, n=0)
+    inj = net.injector
+    net.shard_error(3)
+    net.shard_hang(1, 0.25)
+    net.device_lost(5)
+    net.device_lost("FakeTPU(id=6)")
+    assert inj._shard_errors == [3]
+    assert inj._shard_hangs == [(1, 0.25)]
+    assert 5 in inj._lost_indices
+    assert inj.lost_devices() == ["FakeTPU(id=6)"]
+    net.device_revive(5)
+    assert 5 not in inj._lost_indices
+    net.device_revive(None)
+    assert inj.lost_devices() == [] and not inj._lost_indices
+    inj.heal()
+
+
+def test_injector_shard_fault_resolution_and_one_shot(elastic, monkeypatch):
+    """arm_shard_error is ONE-shot (first dispatch raises, next is clean)
+    and an int device index resolves to the participating device string at
+    dispatch time, so revive-by-index targets the exact device."""
+    _install_host_twins(monkeypatch)
+    inj = DeviceFaultInjector().install()
+    try:
+        pks, msgs, sigs = _signed_rows(93)
+        inj.arm_shard_error(1)
+        mask = batch._verify_batch_streamed(pks, msgs, sigs)
+        assert mask.all()
+        assert batch.LAST_FLUSH_DETAIL.get("mesh_replays") == 1
+        assert ("mesh_rlc_stream_submit", "shard_error:1") in inj.fired
+        assert inj.shard_calls > 0
+
+        inj.arm_device_lost(7)
+        batch._verify_batch_streamed(pks, msgs, sigs)
+        assert inj.lost_devices() == [DEVKEYS[7]]  # resolved at dispatch
+        inj.revive_device(7)  # revive by the SAME index
+        assert inj.lost_devices() == []
+    finally:
+        inj.uninstall()
+        inj.heal()
+
+
+# ---------------------------------------------------------------------------
+# Observability + prewarm satellites.
+
+
+def test_mesh_stats_carry_health_ladder_and_rebuilds(elastic):
+    mesh_tm.reset()
+    hm = health.MESH_HEALTH
+    hm.mark_device_lost(DEVKEYS[2])
+    mesh_tm.record_rebuild(8, 4, 0.0123)
+    mesh_tm.record_mesh_health(hm.snapshot(), "survivor")
+    stats = mesh_tm.mesh_stats()
+    assert stats["ladder"] == "survivor"
+    assert stats["rebuilds"] == 1
+    assert stats["last_rebuild"]["from_devices"] == 8
+    assert stats["last_rebuild"]["to_devices"] == 4
+    # health reads LIVE from the manager: probe streaks advance in place
+    assert stats["health"]["devices"][DEVKEYS[2]]["state"] == "dead"
+    assert stats["health"]["dead"] == 1
+    mesh_tm.reset()
+
+
+def test_prewarm_warms_survivor_mesh_chunk_bucket(elastic, monkeypatch):
+    """The half-mesh runners are built and exercised with one minimal
+    2-chunk pinned flush BEFORE any failure, so the first post-loss flush
+    is a warm dispatch."""
+    import jax
+
+    _install_host_twins(monkeypatch)
+    built = []
+
+    def fake_build(devs):
+        keys = [str(d) for d in devs]
+        built.append(keys)
+        return elastic.env_for(keys)
+
+    monkeypatch.setattr(batch, "_build_sharded_env", fake_build)
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: list(DEVKEYS))
+    pks, msgs, sigs = _signed_rows(1)
+    batch._prewarm_survivor_mesh(pks[0], msgs[0], sigs[0])
+    assert built == [DEVKEYS[:4]]  # exactly the half-mesh topology
+    # the pinned flush streamed 2 chunks through the survivor runners
+    assert batch.LAST_FLUSH_DETAIL["chunks"] == 2
+    assert batch.LAST_JAX_PATH[0] != "rlc-streamed-recovery"
