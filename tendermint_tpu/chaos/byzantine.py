@@ -8,6 +8,16 @@ the RAW key (a byzantine validator ignores the double-sign guard), then
 gossips the conflict. A fabricated hash can never equal the honest prevote,
 so EVERY round produces a detectable equivocation — the honest nodes must
 turn it into DuplicateVoteEvidence and commit it.
+
+`poison_votes` is the signature-poisoning flood (adversarial flush defense,
+crypto/provenance.py): the target gossips `count` votes whose signatures are
+REAL ed25519 signatures — valid point encoding, s < L, so they sail through
+the cheap host precheck — but signed over the WRONG bytes, so they fail the
+device batch verify and force RLC recovery flushes on every honest receiver.
+Each vote carries a distinct fabricated BlockID so the deferred vote-set
+dedup cannot collapse the flood. The defense under test: receivers' suspicion
+scorers must quarantine the poisoning peer (rerouting its rows to the
+scheduler's quarantine lane) and then punish it through the p2p trust scorer.
 """
 
 from __future__ import annotations
@@ -56,3 +66,48 @@ def install_equivocator(node) -> None:
         asyncio.ensure_future(gossip())
 
     cs.do_prevote = byz_do_prevote
+
+
+async def poison_votes(node, count: int) -> int:
+    """Gossip `count` precheck-passing but verify-failing votes from `node`
+    (module docstring). Returns how many were actually broadcast."""
+    from tendermint_tpu.consensus.messages import VoteMessage, encode_message
+    from tendermint_tpu.consensus.reactor import VOTE_CHANNEL
+    from tendermint_tpu.types.basic import BlockID, PartSetHeader, SignedMsgType
+    from tendermint_tpu.types.vote import Vote
+
+    cs = node.consensus
+    rs = cs.rs
+    addr = node.priv_validator.get_pub_key().address()
+    idx, _ = rs.validators.get_by_address(addr)
+    if idx < 0 or node.switch is None:
+        return 0
+    sent = 0
+    for i in range(max(0, int(count))):
+        # distinct fabricated BlockID per vote: the deferred vote-set dedup
+        # keys on (validator, block, signature), so a repeated id would
+        # collapse the flood to one row
+        tag = bytes([0x50 + (i % 0xA0)]) + i.to_bytes(4, "big") + b"\x51" * 27
+        vote = Vote(
+            type=SignedMsgType.PREVOTE,
+            height=rs.height,
+            round=rs.round,
+            block_id=BlockID(tag, PartSetHeader(1, tag)),
+            timestamp_ns=time.time_ns(),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        # the poison: a REAL signature (passes precheck) over bytes that are
+        # NOT this vote's sign bytes (fails verification)
+        sig = node.priv_validator.priv_key.sign(
+            b"tmtpu-sig-poison:" + i.to_bytes(4, "big")
+        )
+        vote = dataclasses.replace(vote, signature=sig)
+        try:
+            await node.switch.broadcast(
+                VOTE_CHANNEL, encode_message(VoteMessage(vote))
+            )
+            sent += 1
+        except Exception:
+            pass  # a dying switch mid-chaos must not kill the flood loop
+    return sent

@@ -56,6 +56,10 @@ class NodeSpec:
     key_type: str = "ed25519"  # validators only: ed25519 | bls12_381
     sync_mode: str = "consensus"  # consensus | blocksync | statesync
     join_at: float = 0.0  # seconds after soak start; 0 = boots with the net
+    # signature poisoner (chaos/byzantine.py poison_votes): this validator
+    # floods the net with precheck-passing, verify-failing votes on every
+    # sig_poison event — the adversarial-flush-defense role
+    poisoner: bool = False
 
 
 class FleetSpec:
@@ -142,6 +146,7 @@ class FleetSpec:
         joiner_frac: float = 0.25,
         bls_validators: int = 1,
         statesync_joiners: int = 1,
+        poisoners: int = 0,
         peer_degree: int = 4,
         episodes: int = 8,
         min_gap: float = 1.0,
@@ -183,6 +188,15 @@ class FleetSpec:
         for vi in rng.sample(range(1, n_val), min(bls_validators, n_val - 1)):
             key_types[vi] = "bls12_381"
 
+        # poisoners are ed25519 validators (never the anchor): they must sit
+        # in the validator set so their fabricated votes clear the vote
+        # set's structural checks and reach batch verification. rng draws
+        # ONLY when requested — existing seeds keep their fingerprints.
+        poison_set: set = set()
+        if poisoners > 0:
+            pool = [i for i in range(1, n_val) if key_types[i] == "ed25519"]
+            poison_set = set(rng.sample(pool, min(poisoners, len(pool))))
+
         full_indices = list(range(n_val, n_nodes - n_light))
         n_join = min(len(full_indices), int(round(len(full_indices) * joiner_frac)))
         joiner_set = set(rng.sample(full_indices, n_join)) if n_join else set()
@@ -195,7 +209,10 @@ class FleetSpec:
         nodes: List[NodeSpec] = []
         for i in range(n_nodes):
             if i < n_val:
-                nodes.append(NodeSpec(i, ROLE_VALIDATOR, key_type=key_types[i]))
+                nodes.append(NodeSpec(
+                    i, ROLE_VALIDATOR, key_type=key_types[i],
+                    poisoner=i in poison_set,
+                ))
             elif i in joiner_set:
                 join_at = round(rng.uniform(*join_window), 2)
                 mode = "statesync" if i in statesync_set else "blocksync"
@@ -282,7 +299,11 @@ class FleetSpec:
         never-started index would early-boot a joiner), and catch-up faults
         aim at the serving validators the joiners sync from."""
         n = len(nodes)
-        protected = {0}  # statesync anchor + snapshot source
+        # statesync anchor + snapshot source; poisoners are protected too —
+        # the soak must keep observing their flood (and its quarantine) the
+        # same way ChaosSchedule.generate protects the equivocator
+        poisoner_idxs = [ns.index for ns in nodes if getattr(ns, "poisoner", False)]
+        protected = {0} | set(poisoner_idxs)
         crashable = [
             ns.index
             for ns in nodes
@@ -346,9 +367,36 @@ class FleetSpec:
                         t, "device_hang", seconds=round(rng.uniform(0.05, 0.3), 3)
                     )
                 )
+            elif kind == "sig_poison":
+                if not poisoner_idxs:
+                    raise ValueError(
+                        "'sig_poison' requested but the fleet has no poisoner "
+                        "nodes (FleetSpec.generate(poisoners=...))"
+                    )
+                # count clears the scorer's quarantine (3) + punish (8)
+                # gates in one flood
+                events.append(
+                    FaultEvent.make(
+                        t, "sig_poison", target=rng.choice(poisoner_idxs),
+                        count=rng.randint(12, 20),
+                    )
+                )
             else:
                 raise ValueError(f"unknown fleet fault kind {kind!r}")
             t += rng.uniform(min_gap, max_gap)
+        if (
+            "sig_poison" in kinds
+            and poisoner_idxs
+            and not any(e.kind == "sig_poison" for e in events)
+        ):
+            # a fleet that seats a poisoner must exercise it: the episode
+            # draw is seeded and may skip the kind, so guarantee one flood
+            events.append(
+                FaultEvent.make(
+                    t, "sig_poison", target=rng.choice(poisoner_idxs),
+                    count=rng.randint(12, 20),
+                )
+            )
         return ChaosSchedule(seed, events)
 
 
@@ -686,6 +734,7 @@ class FleetHarness:
             "verify_lane_wait_light",
             "verify_lane_wait_admission",
             "verify_lane_wait_catchup",
+            "verify_lane_wait_quarantine",
         ):
             setattr(cfg.slo, budget, getattr(cfg.slo, budget) * self.slo_scale)
         for t in (
@@ -699,6 +748,11 @@ class FleetHarness:
             setattr(
                 cfg.consensus, t, getattr(cfg.consensus, t) * self.timeout_scale
             )
+        # deferred vote verification: gossiped votes queue and batch-verify
+        # through the scheduler WITH peer provenance — the path the
+        # adversarial flush defense protects. A sig_poison flood that were
+        # verified serially at ingress would never reach a batch flush.
+        cfg.consensus.defer_vote_verification = True
         # initial nodes run consensus-from-genesis (the all-fresh blocksync
         # handoff races at height 0 — see test_chaos.make_plain_net);
         # staged joiners take the real catch-up paths
@@ -771,6 +825,15 @@ class FleetHarness:
         with open(path, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
         return path
+
+
+def _suspicion_stats() -> Optional[dict]:
+    try:
+        from tendermint_tpu.crypto import provenance as _prov
+
+        return _prov.default_scorer().stats()
+    except Exception:
+        return None
 
 
 async def run_fleet_soak(
@@ -917,6 +980,16 @@ async def run_fleet_soak(
             },
             "chaos_applied": len(engine.applied),
             "chaos_errors": [repr(e) for e in engine.errors],
+            # adversarial flush defense: the process-global suspicion
+            # scorer's view after the soak, plus which node ids the spec's
+            # poisoners booted as (so a referee/test can match
+            # "peer:<id>" quarantine entries back to the seeded adversary)
+            "suspicion": _suspicion_stats(),
+            "poisoners": {
+                ns.index: net.node_ids.get(ns.index)
+                for ns in spec.nodes
+                if getattr(ns, "poisoner", False)
+            },
             "workload": dict(workloads.counters),
             "dumps_dir": dumps_dir,
             "safety_violations": 0,  # assert_safety() would have raised

@@ -195,6 +195,20 @@ class LocalChaosNet:
         if sf is not None:
             sf.arm_chunk_corrupt(count)
 
+    # -- adversarial faults (adversarial flush defense) ----------------------
+
+    async def sig_poison(self, target: int, count: int) -> None:
+        """Node `target` gossips `count` precheck-passing, verify-failing
+        votes (chaos/byzantine.py poison_votes) — the signature-poisoning
+        flood the provenance/quarantine defense must absorb. Crashed
+        targets no-op (a replayed schedule must not abort)."""
+        node = self.nodes[target]
+        if node is None:
+            return
+        from tendermint_tpu.chaos.byzantine import poison_votes
+
+        await poison_votes(node, count)
+
     # -- process faults ------------------------------------------------------
 
     async def crash(self, target: int, wal_fault: Optional[str] = None) -> None:

@@ -57,6 +57,7 @@ LEVEL_BY_KIND = {
     "peer_stall": "catchup",
     "peer_lie": "catchup",
     "chunk_corrupt": "catchup",
+    "sig_poison": "adversary",
 }
 
 
@@ -244,6 +245,17 @@ class ChaosSchedule:
                     FaultEvent.make(
                         t, "chunk_corrupt", target=rng.randrange(n_nodes),
                         count=rng.randint(1, 3),
+                    )
+                )
+            elif kind == "sig_poison":
+                # signature-poisoning flood: the target gossips votes whose
+                # signatures pass precheck but fail real verification —
+                # count must clear the scorer's quarantine (3) + punish (8)
+                # gates so the defense pipeline runs end to end
+                events.append(
+                    FaultEvent.make(
+                        t, "sig_poison", target=rng.randrange(n_nodes),
+                        count=rng.randint(12, 20),
                     )
                 )
             else:

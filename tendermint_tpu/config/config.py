@@ -254,6 +254,9 @@ class SLOConfig:
     verify_lane_wait_light: float = 0.1
     verify_lane_wait_admission: float = 0.1
     verify_lane_wait_catchup: float = 5.0
+    # quarantine flushes only when every other lane is drained (plus a
+    # starvation floor); suspect sources wait accordingly
+    verify_lane_wait_quarantine: float = 30.0
 
 
 @dataclass
@@ -317,6 +320,11 @@ class SchedulerConfig:
     admission_max_wait: float = 0.004
     catchup_max_rows: int = 8192
     catchup_max_wait: float = 0.25
+    # quarantine lane (crypto/provenance.py): rows from sources whose rows
+    # recently failed; flushes ALONE, only when every other lane is empty
+    # (starvation floor = CATCHUP_STARVATION_FACTOR x max_wait)
+    quarantine_max_rows: int = 4096
+    quarantine_max_wait: float = 0.05
     # overload response (node/overload.py calls set_pressure)
     pressure_rows_factor: float = 0.5
     pressure_wait_factor: float = 2.0

@@ -120,7 +120,7 @@ class HeightVoteSet:
                 rounds.append(vote.round)
             else:
                 raise ValueError("peer has sent a vote that does not match our round for more than one round")
-        return vote_set.add_vote(vote)
+        return vote_set.add_vote(vote, peer_id)
 
     def pol_info(self) -> Tuple[int, Optional[BlockID]]:
         """Highest round with a prevote 2/3 majority (reference:

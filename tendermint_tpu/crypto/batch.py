@@ -796,10 +796,80 @@ def _verify_batch_cpu_rlc(pubkeys, msgs, sigs) -> Optional[np.ndarray]:
     return None  # some row is bad: recover the exact mask serially
 
 
+def _verify_serial_host(
+    pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+) -> np.ndarray:
+    """The always-correct serial loop: the host path's exact-mask leaf."""
+    from tendermint_tpu.crypto.keys import Ed25519PubKey
+
+    out = np.zeros(len(pubkeys), dtype=bool)
+    for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
+        try:
+            out[i] = Ed25519PubKey(bytes(pk)).verify(bytes(msg), bytes(sig))
+        except ValueError:
+            out[i] = False
+    return out
+
+
+def _bisect_recover_host(pubkeys, msgs, sigs) -> np.ndarray:
+    """Host-arm twin of _bisect_recover: after the striped host-RLC
+    combined check fails, isolate bad rows with host-RLC sub-checks over
+    pow2 halves and run the serial loop only at small leaves — the CPU
+    fallback under a poisoning flood keeps the same log-cost shape as the
+    device path (docs/ROBUSTNESS.md adversarial flush defense)."""
+    n = len(pubkeys)
+    out = np.zeros(n, dtype=bool)
+    leaf = max(_bisect_leaf_rows() // 4, 1)
+    max_bad = _bisect_max_bad()
+    flushes = 0
+    bad_leaves = 0
+
+    def _combined(lo, hi):
+        nonlocal flushes
+        flushes += 1
+        try:
+            return _verify_batch_cpu_rlc(
+                pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi]
+            )
+        except Exception:
+            return None  # broken host RLC degrades to serial leaves
+
+    def _go(lo, hi):
+        nonlocal flushes, bad_leaves
+        m = hi - lo
+        if m <= leaf or m < 2 * _HOST_RLC_MIN or bad_leaves >= max_bad:
+            flushes += 1
+            bad_leaves += 1
+            out[lo:hi] = _verify_serial_host(
+                pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi]
+            )
+            return
+        half = 1 << ((m - 1).bit_length() - 1)
+        mid = lo + half
+        first = _combined(lo, mid)
+        if first is not None:
+            out[lo:mid] = first
+            _go(mid, hi)
+            return
+        _go(lo, mid)
+        if hi - mid >= _HOST_RLC_MIN and bad_leaves < max_bad:
+            second = _combined(mid, hi)
+            if second is not None:
+                out[mid:hi] = second
+                return
+        _go(mid, hi)
+
+    _go(0, n)
+    LAST_FLUSH_DETAIL["recovery_flushes"] = (
+        LAST_FLUSH_DETAIL.get("recovery_flushes", 0) + flushes
+    )
+    return out
+
+
 def verify_batch_cpu(
     pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> np.ndarray:
-    from tendermint_tpu.crypto.keys import Ed25519PubKey, cofactorless_mode
+    from tendermint_tpu.crypto.keys import cofactorless_mode
 
     n = len(pubkeys)
     if n >= _HOST_RLC_MIN and not cofactorless_mode():
@@ -819,13 +889,14 @@ def verify_batch_cpu(
         if mask is not None:
             LAST_FLUSH_DETAIL["host_rlc"] = True
             return mask
-    out = np.zeros(n, dtype=bool)
-    for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
-        try:
-            out[i] = Ed25519PubKey(bytes(pk)).verify(bytes(msg), bytes(sig))
-        except ValueError:
-            out[i] = False
-    return out
+        if _bisect_enabled():
+            return _bisect_recover_host(pubkeys, msgs, sigs)
+        # naive recovery: one whole-batch serial pass replaces the failed
+        # combined check — count it so the recovery ledger covers both arms
+        LAST_FLUSH_DETAIL["recovery_flushes"] = (
+            LAST_FLUSH_DETAIL.get("recovery_flushes", 0) + 1
+        )
+    return _verify_serial_host(pubkeys, msgs, sigs)
 
 
 def _signed_radix16(vals: np.ndarray) -> np.ndarray:
@@ -2388,11 +2459,142 @@ def _verify_batch_rlc_sharded(
     return None
 
 
+def _bisect_enabled() -> bool:
+    """TMTPU_BISECT=0 restores the straight-to-per-sig recovery (bench
+    baseline arm; docs/ROBUSTNESS.md adversarial flush defense)."""
+    return os.environ.get("TMTPU_BISECT", "1") != "0"
+
+
+def _bisect_leaf_rows() -> int:
+    """Bisection stops splitting at this range size and recovers the leaf
+    per-signature: below a few hundred rows the per-sig kernel's one flush
+    beats two more combined checks."""
+    try:
+        return max(1, int(os.environ.get("TMTPU_BISECT_LEAF", "256")))
+    except ValueError:
+        return 256
+
+
+def _bisect_max_bad() -> int:
+    """Adaptive bail: once this many poisoned leaves have been isolated the
+    flood is dense (high poison rate), so remaining ranges skip their
+    combined checks and go straight per-sig — bisection must never cost
+    more than the straight fallback by a growing factor."""
+    try:
+        return max(1, int(os.environ.get("TMTPU_BISECT_MAX_BAD", "8")))
+    except ValueError:
+        return 8
+
+
+def _persig_flush(pubkeys, msgs, sigs, sharded) -> np.ndarray:
+    """The exact per-signature kernel flush (sharded when a mesh runner is
+    up): the recovery ladder's leaf and the primary path for small/non-RLC
+    batches. Verdict = device mask & host precheck — byte-identical
+    regardless of how the caller partitioned the rows."""
+    from tendermint_tpu.ops.ed25519_jax import verify_prepared
+
+    a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
+    t_dev = time.perf_counter()
+    try:
+        _device_fault("persig")
+        if sharded is not None:
+            LAST_JAX_PATH[0] = "sharded"
+            mask = np.asarray(sharded(a, r, s_bits, h_bits))[:n]
+        else:
+            LAST_JAX_PATH[0] = "persig"
+            mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
+    except Exception as e:
+        _trace.mark_device_call(ok=False, error=repr(e))
+        raise
+    _trace.mark_device_call(ok=True)
+    LAST_FLUSH_DETAIL["transfer_s"] = time.perf_counter() - t_dev
+    return mask & precheck
+
+
+def _bisect_recover(pubkeys, msgs, sigs) -> np.ndarray:
+    """Exact-mask recovery after a combined-check failure, in
+    O(bad · log(chunks)) flushes instead of one monolithic per-sig pass.
+
+    The failed range splits at the largest power of two below its size —
+    sub-ranges land on the SAME warm pow2 lane buckets (_bucket /
+    _LANE_BUCKETS) the fast path compiled, so recovery never compiles a
+    new shape. Each half gets one combined check (sharded when meshed);
+    a passing half is done (RLC pass returns the exact precheck mask, the
+    same invariant the fast path rests on), a failing half recurses. When
+    the first half passes, the second is KNOWN bad (the parent failed) and
+    descends without re-checking. Ranges at/below the leaf size — and
+    everything after _bisect_max_bad() poisoned leaves (dense flood:
+    splitting costs more than it saves) — recover per-signature, the
+    byte-identical code path the straight fallback has always used.
+
+    Cost for one bad row over C = ceil(n/leaf) chunks: at most
+    2·ceil(log2 C)+1 device flushes (<= 2 combined checks per level, one
+    per-sig leaf), vs 1 monolithic per-sig flush of n rows — the win is
+    that n-leaf rows short-circuit through combined checks and the leaf
+    flush is tiny, so a poisoned flood degrades the vote path by a log
+    factor, not a linear one."""
+    n = len(pubkeys)
+    out = np.zeros(n, dtype=bool)
+    leaf = _bisect_leaf_rows()
+    max_bad = _bisect_max_bad()
+    flushes = 0
+    bad_leaves = 0
+
+    def _combined(lo, hi):
+        # Mirrors the fast-path rung choice: sharded combined while a mesh
+        # stands; if the mesh fell MID-CHECK, retry single-chip rather than
+        # mislabel a device loss as a poisoned range.
+        nonlocal flushes
+        flushes += 1
+        pk, ms, sg = pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi]
+        if _sharded_runner() is not None:
+            mask = _verify_batch_rlc_sharded(pk, ms, sg)
+            if mask is not None or _sharded_runner() is not None:
+                return mask
+            flushes += 1
+        return _verify_batch_rlc(pk, ms, sg)
+
+    def _leaf(lo, hi):
+        nonlocal flushes, bad_leaves
+        flushes += 1
+        bad_leaves += 1
+        out[lo:hi] = _persig_flush(
+            pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi], _sharded_runner()
+        )
+
+    def _go(lo, hi):
+        # invariant: [lo, hi) is known to contain at least one bad row
+        m = hi - lo
+        if m <= leaf or m < 2 * RLC_MIN or bad_leaves >= max_bad:
+            _leaf(lo, hi)
+            return
+        half = 1 << ((m - 1).bit_length() - 1)  # largest pow2 < m
+        mid = lo + half
+        first = _combined(lo, mid)
+        if first is not None:
+            out[lo:mid] = first
+            _go(mid, hi)  # parent failed, first half clean: second is bad
+            return
+        _go(lo, mid)
+        if hi - mid >= RLC_MIN and bad_leaves < max_bad:
+            second = _combined(mid, hi)
+            if second is not None:
+                out[mid:hi] = second
+                return
+        _go(mid, hi)
+
+    _go(0, n)
+    LAST_FLUSH_DETAIL["recovery_flushes"] = (
+        LAST_FLUSH_DETAIL.get("recovery_flushes", 0) + flushes
+    )
+    if bad_leaves > 1 or flushes > 1:
+        LAST_JAX_PATH[0] = "rlc-bisect"
+    return out
+
+
 def verify_batch_jax(
     pubkeys: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> np.ndarray:
-    from tendermint_tpu.ops.ed25519_jax import verify_prepared
-
     sharded = _sharded_runner()
     if _rlc_enabled() and len(pubkeys) >= RLC_MIN:
         if planner_engaged(len(pubkeys)):
@@ -2419,28 +2621,22 @@ def verify_batch_jax(
                     LAST_JAX_PATH[0] = "rlc"
                     return mask
         # Combined check failed: at least one signature is bad (or an
-        # encoding was invalid) — recover the exact per-signature mask.
+        # encoding was invalid) — recover the exact per-signature mask,
+        # bisecting over warm pow2 buckets so one poisoned row costs
+        # O(log chunks) flushes, not a monolithic per-sig pass.
         LAST_FLUSH_DETAIL["rlc_fallback"] = True
+        if _bisect_enabled():
+            return _bisect_recover(pubkeys, msgs, sigs)
         # Re-fetch the mesh runner: the RLC attempt above may have rebuilt
         # the mesh (survivor topology) or lost it entirely — the per-sig
         # fallback must not dispatch onto a dead mesh captured earlier.
         sharded = _sharded_runner()
-    a, r, s_bits, h_bits, precheck, n = prepare_batch(pubkeys, msgs, sigs)
-    t_dev = time.perf_counter()
-    try:
-        _device_fault("persig")
-        if sharded is not None:
-            LAST_JAX_PATH[0] = "sharded"
-            mask = np.asarray(sharded(a, r, s_bits, h_bits))[:n]
-        else:
-            LAST_JAX_PATH[0] = "persig"
-            mask = np.asarray(verify_prepared(a, r, s_bits, h_bits))[:n]
-    except Exception as e:
-        _trace.mark_device_call(ok=False, error=repr(e))
-        raise
-    _trace.mark_device_call(ok=True)
-    LAST_FLUSH_DETAIL["transfer_s"] = time.perf_counter() - t_dev
-    return mask & precheck
+        mask = _persig_flush(pubkeys, msgs, sigs, sharded)
+        LAST_FLUSH_DETAIL["recovery_flushes"] = (
+            LAST_FLUSH_DETAIL.get("recovery_flushes", 0) + 1
+        )
+        return mask
+    return _persig_flush(pubkeys, msgs, sigs, sharded)
 
 
 def _verify_batch_mixed_exact(
@@ -2882,8 +3078,14 @@ def verify_batch(
     sigs: Sequence[bytes],
     backend: str | None = None,
     key_types: Sequence[str] | None = None,
+    *,
+    sources: Sequence[str] | None = None,
 ) -> np.ndarray:
     """Verify N (pubkey, msg, sig) triples; returns bool[N].
+
+    sources: optional per-row provenance tags (crypto/provenance.py:
+    "peer:<id>"/"sender:<id>"/"lane:<lane>"). Verdicts feed the suspicion
+    scorer so sources whose rows fail get quarantined; None skips scoring.
 
     key_types: per-row key type ("ed25519"/"sr25519"); None means all
     ed25519. Mixed sets (BASELINE config 5) above RLC_MIN verify BOTH key
@@ -2918,6 +3120,17 @@ def verify_batch(
                 n_valid=nh,
                 memo_hits=nh,
             )
+            if sources is not None:
+                # memo-answered rows verified clean in an earlier flush:
+                # they still count toward a quarantined source's parole
+                try:
+                    from tendermint_tpu.crypto import provenance as _prov
+
+                    _prov.default_scorer().record_rows(
+                        sources, np.ones(nh, dtype=bool)
+                    )
+                except Exception:
+                    pass
             return np.ones(nh, dtype=bool)
         if nh:
             # partial hit: verify only the unseen residue (the recursive
@@ -2930,6 +3143,19 @@ def verify_batch(
                 n_valid=nh,
                 memo_hits=nh,
             )
+            if sources is not None:
+                # memo-answered rows verified clean in an earlier flush:
+                # they still count toward a quarantined source's parole
+                # (same contract as the full-hit path above)
+                try:
+                    from tendermint_tpu.crypto import provenance as _prov
+
+                    _prov.default_scorer().record_rows(
+                        [sources[i] for i in np.flatnonzero(hit)],
+                        np.ones(nh, dtype=bool),
+                    )
+                except Exception:
+                    pass
             miss = ~hit
             idx = np.flatnonzero(miss)
             out = np.ones(len(pubkeys), dtype=bool)
@@ -2939,6 +3165,9 @@ def verify_batch(
                 [sigs[i] for i in idx],
                 backend,
                 [key_types[i] for i in idx] if key_types is not None else None,
+                sources=(
+                    [sources[i] for i in idx] if sources is not None else None
+                ),
             )
             return out
     if _LANE_ROUTER is not None:
@@ -2946,7 +3175,7 @@ def verify_batch(
         # node-wide combined flush; the router returns None outside a scope
         # (and for the scheduler's own dispatch flush), costing one global
         # read + None check on the unrouted path
-        mask = _LANE_ROUTER(pubkeys, msgs, sigs, backend, key_types)
+        mask = _LANE_ROUTER(pubkeys, msgs, sigs, backend, key_types, sources)
         if mask is not None:
             return mask
     tr = _trace.tracer if _trace.tracer.enabled else None  # single flag check
@@ -2968,6 +3197,22 @@ def verify_batch(
         raise
     detail = dict(LAST_FLUSH_DETAIL)
     compile_s = _trace.compile_seconds_total() - compile0
+    quarantined = None
+    if sources is not None:
+        # provenance feed (crypto/provenance.py): count rows whose source
+        # was ALREADY quarantined when this flush ran (attribution for the
+        # quarantine lane), then advance the suspicion state machines with
+        # this flush's verdicts. Advisory: never allowed to break the path.
+        try:
+            from tendermint_tpu.crypto import provenance as _prov
+
+            scorer = _prov.default_scorer()
+            q = scorer.quarantined_sources()
+            if q:
+                quarantined = sum(1 for s in sources if s in q) or None
+            scorer.record_rows(sources, mask)
+        except Exception:
+            quarantined = None
     _trace.record_flush(
         backend=be,
         path=path,
@@ -2989,6 +3234,8 @@ def verify_batch(
         chunk_lanes=detail.get("chunk_lanes"),
         prep_overlap_s=detail.get("prep_overlap_s"),
         prep_stages=detail.get("prep_stages"),
+        recovery_flushes=detail.get("recovery_flushes"),
+        quarantined=quarantined,
         tracer_=tr,
     )
     if span is not None:

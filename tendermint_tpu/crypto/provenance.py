@@ -1,0 +1,292 @@
+"""Row provenance + suspicion scoring (adversarial flush defense).
+
+Every (pubkey, msg, sig) row that enters the batch-verify pipeline carries
+a SOURCE TAG naming where it came from:
+
+- ``peer:<id>``     gossip rows (votes relayed by a p2p peer)
+- ``sender:<id>``   mempool rows (transactions, keyed by sender)
+- ``lane:<lane>``   everything else (a scheduler consumer lane, filled in
+                    by crypto/scheduler.py when the caller supplied none)
+
+The SuspicionScorer watches per-row verdicts flow by (crypto/batch.py
+feeds it after every flush) and keeps a tiny state machine per source:
+
+    clean ──(fails >= fail_quarantine)──> QUARANTINED
+    QUARANTINED ──(clean_streak >= parole_clean)──> clean (parole)
+    QUARANTINED ──(offenses >= punish_fails)──> punish callbacks fire
+
+Quarantined sources are routed by the scheduler to the low-priority
+quarantine lane so their rows can never contaminate a vote/light/admission
+flush again; punish callbacks feed the p2p trust scorer (BAD_MESSAGE ->
+disconnect/ban below the trust threshold) and the mempool sender quota.
+
+Scoring is advisory and must NEVER break the verify path: every external
+touch point (metrics gauge, punish callbacks) is exception-guarded, and
+``is_quarantined`` is a lock-free frozenset membership test so the
+scheduler can consult it per row without contention."""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+# How many distinct sources the scorer remembers (LRU-bounded: a flood of
+# fabricated source ids must not grow memory without bound).
+MAX_SOURCES = 4096
+
+
+def fill_sources(
+    sources: Optional[Sequence[str]], n: int, lane: str
+) -> List[str]:
+    """Normalize a caller-supplied source list to exactly n tags, filling
+    missing/empty entries with the consumer-lane fallback tag."""
+    fallback = f"lane:{lane}"
+    if sources is None:
+        return [fallback] * n
+    out = [s if s else fallback for s in sources]
+    if len(out) < n:
+        out.extend([fallback] * (n - len(out)))
+    return out[:n]
+
+
+class _SourceState:
+    __slots__ = (
+        "fails",
+        "clean_streak",
+        "quarantined",
+        "quarantines",
+        "offenses",
+        "punished",
+    )
+
+    def __init__(self):
+        self.fails = 0  # recent failed rows (decays 1 per clean row)
+        self.clean_streak = 0  # consecutive clean rows (parole gate)
+        self.quarantined = False
+        self.quarantines = 0  # lifetime quarantine entries
+        self.offenses = 0  # failed rows WHILE quarantined (punish gate)
+        self.punished = False  # punish callbacks fired this episode
+
+
+class SuspicionScorer:
+    """Per-source suspicion state machine (module docstring).
+
+    fail_quarantine: failed rows before a source is quarantined.
+    parole_clean:    consecutive clean rows that parole a quarantined source.
+    punish_fails:    failed rows WHILE quarantined before punish callbacks
+                     fire (repeat offender: kept poisoning after isolation).
+
+    Only ATTRIBUTABLE sources (quarantine_prefixes: peer:/sender:) can be
+    quarantined — an anonymous ``lane:`` tag covers every consumer sharing
+    that lane, and a handful of bad catch-up rows must not reroute a whole
+    lane. Anonymous failures are still counted (stats/worst offenders).
+    """
+
+    def __init__(
+        self,
+        *,
+        fail_quarantine: int = 3,
+        parole_clean: int = 64,
+        punish_fails: int = 8,
+        max_sources: int = MAX_SOURCES,
+        quarantine_prefixes: tuple = ("peer:", "sender:"),
+    ):
+        self.fail_quarantine = fail_quarantine
+        self.parole_clean = parole_clean
+        self.punish_fails = punish_fails
+        self.max_sources = max_sources
+        self.quarantine_prefixes = quarantine_prefixes
+        self._lock = threading.Lock()
+        self._state: "OrderedDict[str, _SourceState]" = OrderedDict()
+        # Copy-on-write snapshot: is_quarantined reads this without the lock
+        # (attribute load is atomic), rebuilt only on transitions.
+        self._quarantined: frozenset = frozenset()
+        self._callbacks: List[Callable[[str, dict], None]] = []
+        self._paroles = 0
+        self._punished_total = 0
+
+    # -- feeding ----------------------------------------------------------
+    def record_rows(
+        self, sources: Sequence[str], mask: np.ndarray
+    ) -> None:
+        """Feed one flush's per-row verdicts. sources[i] tags row i; mask[i]
+        is its verdict. Aggregates per source, then advances each source's
+        state machine under the lock."""
+        if not len(sources):
+            return
+        agg: Dict[str, list] = {}
+        for src, ok in zip(sources, np.asarray(mask, dtype=bool)):
+            e = agg.get(src)
+            if e is None:
+                e = agg[src] = [0, 0]
+            e[0 if ok else 1] += 1
+        fire: List[tuple] = []
+        with self._lock:
+            for src, (clean, bad) in agg.items():
+                fire.extend(self._advance_locked(src, bad=bad, clean=clean))
+        for cb, src, info in fire:
+            try:
+                cb(src, info)
+            except Exception:  # punishment must never break verification
+                pass
+        self._publish_gauge()
+
+    def _advance_locked(self, src: str, *, bad: int, clean: int) -> list:
+        st = self._state.get(src)
+        if st is None:
+            st = self._state[src] = _SourceState()
+            self._evict_locked()
+        else:
+            self._state.move_to_end(src)
+        fire: list = []
+        if bad:
+            st.fails += bad
+            st.clean_streak = 0
+            quarantinable = src.startswith(self.quarantine_prefixes)
+            if (
+                quarantinable
+                and not st.quarantined
+                and st.fails >= self.fail_quarantine
+            ):
+                st.quarantined = True
+                st.quarantines += 1
+                st.offenses = 0
+                st.punished = False
+                self._rebuild_quarantined_locked()
+            elif st.quarantined:
+                st.offenses += bad
+                if st.offenses >= self.punish_fails and not st.punished:
+                    st.punished = True
+                    self._punished_total += 1
+                    info = {
+                        "fails": st.fails,
+                        "offenses": st.offenses,
+                        "quarantines": st.quarantines,
+                    }
+                    fire.extend((cb, src, info) for cb in self._callbacks)
+        if clean and not bad:
+            st.clean_streak += clean
+            st.fails = max(0, st.fails - clean)  # honest bit-flips decay
+            if st.quarantined and st.clean_streak >= self.parole_clean:
+                st.quarantined = False
+                st.fails = 0
+                st.offenses = 0
+                st.punished = False
+                st.clean_streak = 0
+                self._paroles += 1
+                self._rebuild_quarantined_locked()
+        return fire
+
+    def _evict_locked(self) -> None:
+        while len(self._state) > self.max_sources:
+            # Evict the oldest NON-quarantined source first; a quarantined
+            # source must not launder its record by flooding fresh ids.
+            victim = None
+            for k, st in self._state.items():
+                if not st.quarantined:
+                    victim = k
+                    break
+            if victim is None:
+                victim = next(iter(self._state))
+            dropped = self._state.pop(victim)
+            if dropped.quarantined:
+                self._rebuild_quarantined_locked()
+
+    def _rebuild_quarantined_locked(self) -> None:
+        self._quarantined = frozenset(
+            k for k, st in self._state.items() if st.quarantined
+        )
+
+    def _publish_gauge(self) -> None:
+        try:
+            from tendermint_tpu.libs import metrics as _metrics
+
+            _metrics.batch_metrics().poisoned_sources.set(
+                len(self._quarantined)
+            )
+        except Exception:  # observability must never break the verify path
+            pass
+
+    # -- queries ----------------------------------------------------------
+    def is_quarantined(self, source: str) -> bool:
+        return source in self._quarantined
+
+    def quarantined_sources(self) -> frozenset:
+        return self._quarantined
+
+    def any_quarantined(self, sources: Iterable[str]) -> bool:
+        q = self._quarantined
+        if not q:
+            return False
+        return any(s in q for s in sources)
+
+    def add_punish_callback(
+        self, cb: Callable[[str, dict], None]
+    ) -> None:
+        with self._lock:
+            self._callbacks.append(cb)
+
+    def remove_punish_callback(
+        self, cb: Callable[[str, dict], None]
+    ) -> None:
+        """Unregister a callback (node shutdown — the scorer is process-
+        global and must not hold references into a stopped node)."""
+        with self._lock:
+            try:
+                self._callbacks.remove(cb)
+            except ValueError:
+                pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            worst = sorted(
+                self._state.items(),
+                key=lambda kv: (kv[1].quarantined, kv[1].fails),
+                reverse=True,
+            )[:8]
+            return {
+                "sources": len(self._state),
+                "quarantined": sorted(self._quarantined),
+                "paroles": self._paroles,
+                "punished": self._punished_total,
+                "worst": [
+                    {
+                        "source": k,
+                        "fails": st.fails,
+                        "clean_streak": st.clean_streak,
+                        "quarantined": st.quarantined,
+                        "quarantines": st.quarantines,
+                    }
+                    for k, st in worst
+                    if st.fails or st.quarantined
+                ],
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._state.clear()
+            self._quarantined = frozenset()
+            self._paroles = 0
+            self._punished_total = 0
+        self._publish_gauge()
+
+
+_DEFAULT = SuspicionScorer()
+
+
+def default_scorer() -> SuspicionScorer:
+    """The process-global scorer: the crypto pipeline is process-global
+    state (same pattern as the verified-row memo), so suspicion learned by
+    any in-process node's flushes protects every node."""
+    return _DEFAULT
+
+
+def set_default(scorer: SuspicionScorer) -> SuspicionScorer:
+    """Swap the process-global scorer (tests); returns the previous one."""
+    global _DEFAULT
+    prev = _DEFAULT
+    _DEFAULT = scorer
+    return prev

@@ -27,6 +27,12 @@ Lanes, in priority order:
     catchup    blocksync replay + evidence re-verification. Soaks IDLE
                device capacity only: scheduled when no higher lane has
                rows, with a starvation floor so a busy node still syncs.
+    quarantine rows from sources the suspicion scorer has quarantined
+               (crypto/provenance.py: peers/senders whose rows recently
+               failed). Flushes ALONE, only when every other lane is
+               empty (plus a starvation floor), so a poisoning flood can
+               force recovery bisections only on its own flushes — never
+               on a vote/light/admission flush again.
 
 Budgets respond to the PR 5 overload controller (node/overload.py calls
 `set_pressure`): level 1 shrinks the admission/catch-up budgets (fewer rows
@@ -88,7 +94,7 @@ __all__ = [
 ]
 
 # priority order: index 0 preempts everything below it
-LANES = ("votes", "light", "admission", "catchup")
+LANES = ("votes", "light", "admission", "catchup", "quarantine")
 
 # a starving catch-up lane flushes anyway after this many times its
 # configured idle wait (unless pressure level 2 pauses it): "soaks idle
@@ -261,6 +267,10 @@ class VerifyScheduler:
                                   float(config.admission_max_wait)),
             "catchup": _Budgets(int(config.catchup_max_rows),
                                 float(config.catchup_max_wait)),
+            "quarantine": _Budgets(
+                int(getattr(config, "quarantine_max_rows", 4096)),
+                float(getattr(config, "quarantine_max_wait", 0.05)),
+            ),
         }
         self.pressure_level = 0
         self.wait_timeout = float(getattr(config, "wait_timeout", 30.0))
@@ -323,10 +333,13 @@ class VerifyScheduler:
 
     def submit(self, lane: str, pubkeys: Sequence[bytes],
                msgs: Sequence[bytes], sigs: Sequence[bytes],
-               key_types: Optional[Sequence[str]] = None) -> Optional[Ticket]:
+               key_types: Optional[Sequence[str]] = None,
+               sources: Optional[Sequence[str]] = None) -> Optional[Ticket]:
         """Queue one consumer's rows on `lane`; returns a Ticket (None when
         the scheduler is closed — callers verify inline then). Thread-safe;
-        never blocks beyond the lane mutex."""
+        never blocks beyond the lane mutex. `sources` is the rows' optional
+        provenance (crypto/provenance.py tags); None tags them with the
+        consumer lane at flush time."""
         if lane not in self._lanes:
             raise ValueError(f"unknown verify lane {lane!r}")
         n = len(pubkeys)
@@ -337,11 +350,14 @@ class VerifyScheduler:
             ticket._resolve(np.zeros(0, dtype=bool), None)
             return ticket
         kt = list(key_types) if key_types is not None else None
+        src = list(sources) if sources is not None else None
         with self._cv:
             if self._closed:
                 return None
             st = self._lanes[lane]
-            st.queue.append((ticket, list(pubkeys), list(msgs), list(sigs), kt))
+            st.queue.append(
+                (ticket, list(pubkeys), list(msgs), list(sigs), kt, src)
+            )
             st.rows += n
             if self.metrics is not None:
                 self.metrics.lane_depth.labels(lane).set(st.rows)
@@ -349,11 +365,17 @@ class VerifyScheduler:
         return ticket
 
     def verify_rows(self, lane: str, pubkeys, msgs, sigs,
-                    key_types=None) -> np.ndarray:
+                    key_types=None, sources=None) -> np.ndarray:
         """Submit + block for the verdict slice — the drop-in replacement
         for a consumer's own `verify_batch(...)` call. Falls back to an
         inline verify_batch when the scheduler is closed or the ticket
         misses wait_timeout (a consumer is never wedged on the lane).
+
+        Rows whose source is QUARANTINED (crypto/provenance.py) split off
+        first and ride the quarantine lane instead, so a poisoning flood
+        can never drag a vote/light/admission flush into bisection
+        recovery (_verify_rows_partitioned merges the verdicts back in
+        row order — the caller sees one mask either way).
 
         The VOTES lane never queues here: vote rows would flush alone
         anyway (bulk rows never ride a vote flush), so queuing them behind
@@ -362,14 +384,58 @@ class VerifyScheduler:
         not queuing at all: the vote flush runs immediately on the caller's
         thread, with full lane accounting (depth-0 wait, flush journal,
         preemption count when bulk work sat queued)."""
-        if lane == "votes":
-            return self._verify_votes_inline(pubkeys, msgs, sigs, key_types)
-        ticket = self.submit(lane, pubkeys, msgs, sigs, key_types)
-        if ticket is None:
-            return self._inline(pubkeys, msgs, sigs, key_types)
-        return self._wait_or_fallback(ticket, (pubkeys, msgs, sigs, key_types))
+        if lane != "quarantine" and sources is not None:
+            from tendermint_tpu.crypto import provenance as _prov
 
-    def _verify_votes_inline(self, pubkeys, msgs, sigs, key_types) -> np.ndarray:
+            q = _prov.default_scorer().quarantined_sources()
+            if q and any(s in q for s in sources):
+                return self._verify_rows_partitioned(
+                    lane, pubkeys, msgs, sigs, key_types, sources, q
+                )
+        if lane == "votes":
+            return self._verify_votes_inline(pubkeys, msgs, sigs, key_types,
+                                             sources)
+        ticket = self.submit(lane, pubkeys, msgs, sigs, key_types, sources)
+        if ticket is None:
+            return self._inline(pubkeys, msgs, sigs, key_types, sources)
+        return self._wait_or_fallback(
+            ticket, (pubkeys, msgs, sigs, key_types, sources)
+        )
+
+    def _verify_rows_partitioned(self, lane, pubkeys, msgs, sigs, key_types,
+                                 sources, quarantined) -> np.ndarray:
+        """Split a submit whose sources are partly quarantined: suspect rows
+        queue on the quarantine lane FIRST (non-blocking), the clean rows
+        flush through their own lane as usual, then this thread blocks for
+        the quarantine verdict and merges the masks in row order."""
+        idx_q = [i for i, s in enumerate(sources) if s in quarantined]
+        idx_c = [i for i, s in enumerate(sources) if s not in quarantined]
+
+        def _take(seq, idx):
+            return [seq[i] for i in idx]
+
+        out = np.zeros(len(pubkeys), dtype=bool)
+        q_rows = (
+            _take(pubkeys, idx_q), _take(msgs, idx_q), _take(sigs, idx_q),
+            _take(key_types, idx_q) if key_types is not None else None,
+            _take(sources, idx_q),
+        )
+        q_ticket = self.submit("quarantine", *q_rows)
+        if idx_c:
+            out[idx_c] = self.verify_rows(
+                lane,
+                _take(pubkeys, idx_c), _take(msgs, idx_c), _take(sigs, idx_c),
+                _take(key_types, idx_c) if key_types is not None else None,
+                _take(sources, idx_c),
+            )
+        if q_ticket is None:
+            out[idx_q] = self._inline(*q_rows)
+        else:
+            out[idx_q] = self._wait_or_fallback(q_ticket, q_rows)
+        return out
+
+    def _verify_votes_inline(self, pubkeys, msgs, sigs, key_types,
+                             sources=None) -> np.ndarray:
         n = len(pubkeys)
         if n == 0:
             return np.zeros(0, dtype=bool)
@@ -382,7 +448,7 @@ class VerifyScheduler:
                 self.preemptions += 1
                 if self.metrics is not None:
                     self.metrics.preemptions.inc()
-        mask = self._inline(pubkeys, msgs, sigs, key_types)
+        mask = self._inline(pubkeys, msgs, sigs, key_types, sources)
         wall = time.monotonic() - t0
         with self._cv:
             self.flush_seq += 1
@@ -427,10 +493,18 @@ class VerifyScheduler:
                 raise
             return self._inline(*rows)
 
-    def _inline(self, pubkeys, msgs, sigs, key_types) -> np.ndarray:
+    def _inline(self, pubkeys, msgs, sigs, key_types,
+                sources=None) -> np.ndarray:
         from tendermint_tpu.crypto import batch as _batch
 
-        return _batch.verify_batch(pubkeys, msgs, sigs, self.backend, key_types)
+        if sources is None:
+            # keep the untagged call shape: tests stub verify_batch with
+            # 5-arg fakes, and an untagged flush has nothing to score
+            return _batch.verify_batch(
+                pubkeys, msgs, sigs, self.backend, key_types
+            )
+        return _batch.verify_batch(pubkeys, msgs, sigs, self.backend, key_types,
+                                   sources=sources)
 
     def accumulate(self, lane: str) -> LaneAccumulator:
         """A FlushAccumulator-compatible adapter whose flush() rides `lane`
@@ -514,6 +588,36 @@ class VerifyScheduler:
             else:
                 dl = oldest + eff.max_wait
                 next_deadline = dl if next_deadline is None else min(next_deadline, dl)
+        # Quarantine: suspect rows flush ALONE, and only when every other
+        # lane is drained — a poisoned flood's bisection recoveries can
+        # never ride, or be ridden by, clean work. The starvation floor
+        # (same factor as catch-up) bounds how long a suspect consumer
+        # blocks, so parole stays reachable and the wait_timeout inline
+        # fallback stays the backstop, not the norm.
+        qst = self._lanes["quarantine"]
+        if qst.queue:
+            eff = self.effective_budget("quarantine")
+            oldest = qst.queue[0][0].enqueued_t
+            wait = now - oldest
+            floor = eff.max_wait * CATCHUP_STARVATION_FACTOR
+            others = bulk_pending or bool(self._lanes["catchup"].queue)
+            triggered = (not others and not ready) and (
+                wait >= eff.max_wait
+                or (eff.max_rows > 0 and qst.rows >= eff.max_rows)
+            )
+            if triggered or wait >= floor:
+                entries = []
+                taken_rows = 0
+                while qst.queue:
+                    if eff.max_rows > 0 and taken_rows >= eff.max_rows:
+                        break
+                    entry = qst.queue.popleft()
+                    qst.rows -= entry[0].rows
+                    taken_rows += entry[0].rows
+                    entries.append(entry)
+                return entries, {"quarantine"}, False, None
+            dl = oldest + (floor if (others or ready) else eff.max_wait)
+            next_deadline = dl if next_deadline is None else min(next_deadline, dl)
         if not ready:
             timeout = None if next_deadline is None else max(0.0, next_deadline - now)
             return [], set(), False, timeout
@@ -546,6 +650,7 @@ class VerifyScheduler:
 
     def _run(self) -> None:
         while True:
+            q_entries: list = []
             with self._cv:
                 entries: list = []
                 while not self._closed:
@@ -556,13 +661,18 @@ class VerifyScheduler:
                 if self._closed:
                     # drain everything still queued in one final pass so no
                     # consumer blocks into its fallback timeout on teardown
+                    # (quarantined rows still flush separately: the
+                    # isolation invariant holds through teardown too)
                     entries = []
                     lanes, preempted = set(), False
                     for lane in LANES:
                         st = self._lanes[lane]
                         if st.queue:
-                            lanes.add(lane)
-                        entries.extend(st.queue)
+                            if lane == "quarantine":
+                                q_entries = list(st.queue)
+                            else:
+                                lanes.add(lane)
+                                entries.extend(st.queue)
                         st.queue.clear()
                         st.rows = 0
                 if preempted:
@@ -572,6 +682,8 @@ class VerifyScheduler:
                 closed = self._closed
             if entries:
                 self._flush(entries, lanes)
+            if q_entries:
+                self._flush(q_entries, {"quarantine"})
             if closed:
                 return
 
@@ -587,25 +699,37 @@ class VerifyScheduler:
         msgs: list = []
         sigs: list = []
         key_types: list = []
+        sources: list = []
         slices = []
         lane_rows: Dict[str, int] = {}
         lane_oldest: Dict[str, float] = {}
-        for ticket, pk, ms, sg, kt in entries:
+        for ticket, pk, ms, sg, kt, src in entries:
             start = len(pubkeys)
             pubkeys.extend(pk)
             msgs.extend(ms)
             sigs.extend(sg)
             key_types.extend(kt if kt is not None else ["ed25519"] * len(pk))
+            # provenance: untagged rows sharing a flush with tagged ones
+            # carry their consumer lane, so the suspicion scorer can always
+            # attribute a failing row (crypto/provenance.py tag conventions)
+            sources.extend(
+                src if src is not None else [f"lane:{ticket.lane}"] * len(pk)
+            )
             slices.append((ticket, start, len(pubkeys)))
             lane_rows[ticket.lane] = lane_rows.get(ticket.lane, 0) + ticket.rows
             prev = lane_oldest.get(ticket.lane)
             if prev is None or ticket.enqueued_t < prev:
                 lane_oldest[ticket.lane] = ticket.enqueued_t
         kt_arg = key_types if any(t != "ed25519" for t in key_types) else None
+        # an all-untagged flush passes sources=None: nothing to score, and
+        # the untagged verify_batch call shape stays byte-for-byte the same
+        src_arg = (
+            sources if any(e[5] is not None for e in entries) else None
+        )
         mask: Optional[np.ndarray] = None
         error: Optional[BaseException] = None
         try:
-            mask = self._verify_chunked(pubkeys, msgs, sigs, kt_arg)
+            mask = self._verify_chunked(pubkeys, msgs, sigs, kt_arg, src_arg)
         except BaseException as e:  # tickets re-raise; the thread survives
             error = e
             logger.exception(
@@ -646,7 +770,8 @@ class VerifyScheduler:
             ticket.wait_s = t_flush - ticket.enqueued_t
             ticket._resolve(mask[start:end] if mask is not None else None, error)
 
-    def _verify_chunked(self, pubkeys, msgs, sigs, kt_arg) -> np.ndarray:
+    def _verify_chunked(self, pubkeys, msgs, sigs, kt_arg,
+                        sources=None) -> np.ndarray:
         """The dispatch thread's verify body: an oversized combined flush
         (catch-up super-batches, admission floods) splits into flush-planner
         chunks (crypto/batch.planner_chunk_rows) with a PREEMPTION POINT
@@ -660,18 +785,32 @@ class VerifyScheduler:
         chunk = _batch.planner_chunk_rows()
         n = len(pubkeys)
         if n <= chunk:
-            return _batch.verify_batch(pubkeys, msgs, sigs, self.backend, kt_arg)
+            if sources is None:
+                return _batch.verify_batch(
+                    pubkeys, msgs, sigs, self.backend, kt_arg
+                )
+            return _batch.verify_batch(pubkeys, msgs, sigs, self.backend,
+                                       kt_arg, sources=sources)
         parts = []
         for lo in range(0, n, chunk):
             if lo:
                 self._preempt_votes_between_chunks()
             hi = min(lo + chunk, n)
-            parts.append(
-                _batch.verify_batch(
-                    pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi], self.backend,
-                    kt_arg[lo:hi] if kt_arg is not None else None,
+            kt_c = kt_arg[lo:hi] if kt_arg is not None else None
+            if sources is None:
+                parts.append(
+                    _batch.verify_batch(
+                        pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi],
+                        self.backend, kt_c,
+                    )
                 )
-            )
+            else:
+                parts.append(
+                    _batch.verify_batch(
+                        pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi],
+                        self.backend, kt_c, sources=sources[lo:hi],
+                    )
+                )
         return np.concatenate(parts)
 
     def _preempt_votes_between_chunks(self) -> None:
@@ -740,6 +879,15 @@ class VerifyScheduler:
             out["mesh_ladder"] = _batch.mesh_ladder_state()
         except Exception:
             out["mesh_ladder"] = None
+        # Adversarial flush defense (crypto/provenance.py): which sources
+        # are quarantined / closest to it, on the same surface operators
+        # already read lane health from.
+        try:
+            from tendermint_tpu.crypto import provenance as _prov
+
+            out["suspicion"] = _prov.default_scorer().stats()
+        except Exception:
+            out["suspicion"] = None
         return out
 
     def close(self) -> None:
@@ -762,7 +910,7 @@ class VerifyScheduler:
 _TLS = threading.local()
 
 
-def _route_rows(pubkeys, msgs, sigs, backend, key_types):
+def _route_rows(pubkeys, msgs, sigs, backend, key_types, sources=None):
     """crypto/batch's lane router: verify_batch consults this at entry and,
     when the calling thread sits inside a lane_scope, routes the rows
     through that scheduler lane. Returns None (= route normally) outside a
@@ -776,7 +924,7 @@ def _route_rows(pubkeys, msgs, sigs, backend, key_types):
         return None
     _TLS.scope = None  # the inline fallback must not re-enter the router
     try:
-        return sched.verify_rows(lane, pubkeys, msgs, sigs, key_types)
+        return sched.verify_rows(lane, pubkeys, msgs, sigs, key_types, sources)
     finally:
         _TLS.scope = scope
 

@@ -694,6 +694,23 @@ class BatchVerifyMetrics:
             f"{ns}_rlc_fallbacks_total",
             "RLC combined-check failures recovered via the per-signature path.",
         )
+        # adversarial flush defense (crypto/batch.py bisection recovery +
+        # crypto/provenance.py suspicion scoring, docs/ROBUSTNESS.md)
+        self.recovery_flushes = reg.counter(
+            f"{ns}_recovery_flushes_total",
+            "Device/host flushes spent isolating bad rows after a combined-"
+            "check failure (RLC bisection sub-checks + per-sig leaves).",
+        )
+        self.quarantined_rows = reg.counter(
+            f"{ns}_quarantined_rows_total",
+            "Rows verified while their source was quarantined (routed "
+            "through the scheduler's quarantine lane).",
+        )
+        self.poisoned_sources = reg.gauge(
+            f"{ns}_poisoned_sources",
+            "Sources currently quarantined by the suspicion scorer "
+            "(peer:/sender:/lane: tags whose rows recently failed).",
+        )
         # signature-scheme attribution (ISSUE 14): BLS rows must never fold
         # into the ed25519 RLC headline — perf_ledger grows the matching
         # backend column from bench results, this is the live-node series

@@ -111,6 +111,13 @@ OBJECTIVES = {
         "before its flush started (idle-soak by design; the starvation "
         "floor bounds it)",
     ),
+    "verify_lane_wait_quarantine": (
+        "verify_lane_wait_quarantine",
+        "seconds a queued quarantine-lane row (suspect source, "
+        "crypto/provenance.py) waited before its flush started (flushes "
+        "alone, only when every other lane is drained; the starvation "
+        "floor bounds it)",
+    ),
 }
 
 # ring bound per objective: at soak rates (~10 obs/s) this covers the slow

@@ -175,7 +175,13 @@ tracer = Tracer(enabled=os.environ.get("TMTPU_TRACE", "1") != "0")
 _STATS_LOCK = threading.Lock()
 _TOTALS: Dict[tuple, Dict[str, float]] = {}  # (backend, path) -> counters
 _LAST_FLUSH: Dict[str, Any] = {}
-_COUNTS = {"rlc_fallbacks": 0, "cache_hits": 0, "cache_misses": 0}
+_COUNTS = {
+    "rlc_fallbacks": 0,
+    "cache_hits": 0,
+    "cache_misses": 0,
+    "recovery_flushes": 0,
+    "quarantined_rows": 0,
+}
 _STAGE_SECONDS = {"prep": 0.0, "compile": 0.0, "transfer": 0.0, "total": 0.0}
 # Slope-methodology raw data (PERF.md: single-sync timings lie on this
 # runtime, so per-batch cost is fit from (k, seconds) over k chained
@@ -219,6 +225,8 @@ def record_flush(
     prep_overlap_s: Optional[float] = None,
     prep_stages: Optional[dict] = None,
     memo_hits: Optional[int] = None,
+    recovery_flushes: Optional[int] = None,
+    quarantined: Optional[int] = None,
     tracer_: Optional[Tracer] = None,
 ) -> None:
     """One batch-verify flush completed. Called by crypto/batch.verify_batch
@@ -252,6 +260,12 @@ def record_flush(
         m.pubkey_cache_misses.inc(cache_misses)
     if rlc_fallback:
         m.rlc_fallbacks.inc()
+    # adversarial flush defense (crypto/batch.py _bisect_recover +
+    # crypto/provenance.py): recovery cost + quarantined-row attribution
+    if recovery_flushes:
+        m.recovery_flushes.inc(recovery_flushes)
+    if quarantined:
+        m.quarantined_rows.inc(quarantined)
     # streamed flush planner (crypto/batch.py ISSUE 13): chunk count per
     # flush + the host-prep wall the double buffer hid behind device work
     if chunks is not None:
@@ -308,6 +322,10 @@ def record_flush(
         }
     if memo_hits is not None:
         last["memo_hits"] = memo_hits
+    if recovery_flushes is not None:
+        last["recovery_flushes"] = recovery_flushes
+    if quarantined is not None:
+        last["quarantined"] = quarantined
     with _STATS_LOCK:
         t = _TOTALS.setdefault(
             (backend, path), {"flushes": 0, "sigs": 0, "seconds": 0.0}
@@ -319,6 +337,8 @@ def record_flush(
         _COUNTS["cache_misses"] += cache_misses or 0
         if rlc_fallback:
             _COUNTS["rlc_fallbacks"] += 1
+        _COUNTS["recovery_flushes"] += recovery_flushes or 0
+        _COUNTS["quarantined_rows"] += quarantined or 0
         _STAGE_SECONDS["prep"] += prep_s or 0.0
         _STAGE_SECONDS["compile"] += compile_s or 0.0
         _STAGE_SECONDS["transfer"] += transfer_s or 0.0

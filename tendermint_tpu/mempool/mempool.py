@@ -153,6 +153,10 @@ class Mempool:
         self._txs_available_cb: Optional[Callable[[], None]] = None
         self._notified_txs_available = False
         self._sender_counts: Dict[str, int] = {}  # admitting sender -> in-flight txs
+        # senders punished for signature poisoning (crypto/provenance.py punish
+        # callbacks, wired through node.py): their per-sender quota collapses
+        # to PENALIZED_SENDER_QUOTA regardless of max_txs_per_sender
+        self._penalized_senders: set = set()
         self.evicted_total = 0
         self.expired_total = 0
 
@@ -286,7 +290,7 @@ class Mempool:
 
     def _sig_precheck_batch(
         self, txs: List[bytes], keys: Optional[List[bytes]] = None,
-        skip_cache_peek: bool = False,
+        skip_cache_peek: bool = False, sender: str = "",
     ) -> List[int]:
         """Batch-verify the signed-tx envelopes among `txs` through the
         scheduler's admission lane; returns one abci.SIG_PRECHECK_* verdict
@@ -322,11 +326,16 @@ class Mempool:
         if not rows:
             return verdicts
         try:
+            # provenance (crypto/provenance.py): gossiped rows carry their
+            # sender so the suspicion scorer can quarantine and punish a
+            # poisoning peer; local RPC submissions stay lane-tagged
+            sources = [f"sender:{sender}"] * len(rows) if sender else None
             mask = self.scheduler.verify_rows(
                 "admission",
                 [e.pubkey for e in rows],
                 [e.sign_bytes for e in rows],
                 [e.signature for e in rows],
+                sources=sources,
             )
         except Exception:
             # a broken scheduler must never lose txs: NONE degrades to the
@@ -342,6 +351,22 @@ class Mempool:
             verdicts[i] = abci.SIG_PRECHECK_OK if ok else abci.SIG_PRECHECK_BAD
         return verdicts
 
+    PENALIZED_SENDER_QUOTA = 2  # in-flight txs allowed from a punished poisoner
+
+    def penalize_sender(self, sender: str) -> None:
+        """Punishment hook for signature poisoning (crypto/provenance.py
+        punish callbacks, wired through node.py): collapse the sender's
+        per-sender quota to PENALIZED_SENDER_QUOTA. Idempotent; survives
+        flush() so a poisoner cannot launder its record through a commit."""
+        if not sender:
+            return
+        with self._lock:
+            self._penalized_senders.add(sender)
+
+    def penalized_senders(self) -> frozenset:
+        with self._lock:
+            return frozenset(self._penalized_senders)
+
     def check_tx(self, tx: bytes, sender: str = "") -> Optional[abci.ResponseCheckTx]:
         """(reference: mempool/clist_mempool.go:234 CheckTx + resCbFirstTime :404)
 
@@ -353,7 +378,7 @@ class Mempool:
         key = b""
         if self.sig_precheck:
             key = tmhash.sum256(tx)
-            sig_verdict = self._sig_precheck_batch([tx], keys=[key])[0]
+            sig_verdict = self._sig_precheck_batch([tx], keys=[key], sender=sender)[0]
         return self._check_tx_admit(tx, sender, sig_verdict, key)
 
     def check_tx_batch(
@@ -367,7 +392,7 @@ class Mempool:
         keys: List[bytes] = []
         if self.sig_precheck:
             keys = [tmhash.sum256(tx) for tx in txs]
-        verdicts = self._sig_precheck_batch(txs, keys=keys or None)
+        verdicts = self._sig_precheck_batch(txs, keys=keys or None, sender=sender)
         out: List[Optional[abci.ResponseCheckTx]] = []
         for i, (tx, v) in enumerate(zip(txs, verdicts)):
             try:
@@ -412,6 +437,13 @@ class Mempool:
                 tt.record(key, "received", via="gossip" if sender else "rpc")
             if len(tx) > self.max_tx_bytes:
                 return self._reject(TxTooLargeError(len(tx), self.max_tx_bytes), sender, key)
+            if sender and sender in self._penalized_senders:
+                # punished poisoner: quota collapses even when the operator
+                # configured unlimited per-sender admission
+                if self._sender_counts.get(sender, 0) >= self.PENALIZED_SENDER_QUOTA:
+                    return self._reject(
+                        SenderQuotaError(sender, self.PENALIZED_SENDER_QUOTA), sender, key
+                    )
             if (
                 sender
                 and self.max_txs_per_sender > 0

@@ -583,7 +583,40 @@ class Node:
             )
         if self.config.overload.enabled:
             self.overload.start()
+        self._install_punish_hook()
         logger.info("node started (chain %s)", self.genesis.chain_id)
+
+    def _install_punish_hook(self) -> None:
+        """Route suspicion-scorer punishments (crypto/provenance.py) into
+        the existing enforcement machinery: a punished ``peer:<id>`` feeds
+        the p2p trust scorer a BAD_MESSAGE report (repeated reports drop the
+        peer below the trust threshold and disconnect it), and a punished
+        ``sender:<id>`` collapses that sender's mempool quota. The callback
+        fires on a verify thread, so p2p reports hop to the event loop."""
+        from tendermint_tpu.crypto import provenance as _prov
+
+        loop = asyncio.get_event_loop()
+
+        def punish(source: str, info: dict) -> None:
+            if source.startswith("peer:"):
+                if self.switch is None:
+                    return
+                from tendermint_tpu.p2p.behaviour import BAD_MESSAGE, PeerBehaviour
+
+                pb = PeerBehaviour(
+                    source[len("peer:"):], BAD_MESSAGE,
+                    f"signature poisoning ({info.get('offenses', 0)} bad rows in quarantine)",
+                )
+
+                def _report():
+                    asyncio.ensure_future(self.switch.reporter.report(pb))
+
+                loop.call_soon_threadsafe(_report)
+            elif source.startswith("sender:"):
+                self.mempool.penalize_sender(source[len("sender:"):])
+
+        self._punish_cb = punish
+        _prov.default_scorer().add_punish_callback(punish)
 
     async def _run_state_sync(self) -> None:
         """Restore from a peer snapshot, bootstrap the stores, then hand off
@@ -677,6 +710,11 @@ class Node:
 
     async def stop(self) -> None:
         self._running = False
+        if getattr(self, "_punish_cb", None) is not None:
+            from tendermint_tpu.crypto import provenance as _prov
+
+            _prov.default_scorer().remove_punish_callback(self._punish_cb)
+            self._punish_cb = None
         if self.light_service is not None:
             self.light_service.close()
         if self.scheduler is not None:

@@ -119,6 +119,15 @@ def capture_node_dump(node, hash_window: int = 64) -> dict:
     except Exception as e:
         doc["mesh"] = {"error": repr(e)}
     try:
+        from tendermint_tpu.crypto import provenance as _prov
+
+        # the suspicion scorer is process-global (like the mesh): every
+        # in-process node's dump carries the same snapshot, and the fleet
+        # referee folds them with a union, not a sum
+        doc["suspicion"] = _prov.default_scorer().stats()
+    except Exception as e:
+        doc["suspicion"] = {"error": repr(e)}
+    try:
         sw = getattr(node, "switch", None)
         peers = {}
         if sw is not None:

@@ -250,6 +250,44 @@ def build_report(
             "nodes": dict(sorted(mesh_nodes.items())),
         }
 
+    # quarantine/recovery column (ISSUE 20): each dump's `suspicion` section
+    # snapshots the process-global SuspicionScorer and its verify_stats
+    # counters carry the recovery-flush total — fold with a UNION (and max
+    # over the shared counters), never a sum: in-process fleets share one
+    # scorer, so every dump repeats the same snapshot. A soak where a
+    # poisoner got quarantined (or punished) reads straight off the report.
+    quarantined_union: set = set()
+    punished_max = 0
+    paroles_max = 0
+    recovery_max = 0
+    quarantined_rows_max = 0
+    saw_suspicion = False
+    for dump in dumps:
+        sus = dump.get("suspicion")
+        if isinstance(sus, dict) and not sus.get("error"):
+            saw_suspicion = True
+            quarantined_union.update(sus.get("quarantined") or [])
+            punished_max = max(punished_max, int(sus.get("punished") or 0))
+            paroles_max = max(paroles_max, int(sus.get("paroles") or 0))
+        vs = dump.get("verify_stats")
+        counters = (vs or {}).get("counters") if isinstance(vs, dict) else None
+        if isinstance(counters, dict):
+            recovery_max = max(
+                recovery_max, int(counters.get("recovery_flushes") or 0)
+            )
+            quarantined_rows_max = max(
+                quarantined_rows_max, int(counters.get("quarantined_rows") or 0)
+            )
+    quarantine = None
+    if saw_suspicion:
+        quarantine = {
+            "quarantined_sources": sorted(quarantined_union),
+            "punished": punished_max,
+            "paroles": paroles_max,
+            "recovery_flushes": recovery_max,
+            "quarantined_rows": quarantined_rows_max,
+        }
+
     # fleet-wide terminal accounting (delivered/rejected/evicted/expired)
     terminals: Dict[str, int] = {}
     for terms in (merged.get("tx_terminals") or {}).values():
@@ -290,6 +328,7 @@ def build_report(
         "slo_any_tripped": merged["slo_any_tripped"],
         "waterfall": waterfall,
         "mesh_degrade": mesh_degrade,
+        "quarantine": quarantine,
         "terminals": terminals,
         "slowest_link_counts": merged["slowest_link_counts"],
         "worst_offender": merged["worst_offender"],
@@ -416,6 +455,20 @@ def render_markdown(report: dict) -> str:
         lines.append(
             f"worst ladder rung: {mark}{worst or '?'}{mark} · "
             f"{md.get('rebuilds_total', 0)} mesh rebuild(s) fleet-wide"
+        )
+        lines.append("")
+
+    q = report.get("quarantine")
+    if q:
+        lines.append("## Adversarial flush defense")
+        lines.append("")
+        srcs = q.get("quarantined_sources") or []
+        mark = "**" if srcs else ""
+        lines.append(
+            f"quarantined sources: {mark}{', '.join(srcs) or 'none'}{mark} · "
+            f"{q.get('punished', 0)} punished · {q.get('paroles', 0)} paroled · "
+            f"{q.get('recovery_flushes', 0)} recovery flush(es) · "
+            f"{q.get('quarantined_rows', 0)} quarantined row(s)"
         )
         lines.append("")
 

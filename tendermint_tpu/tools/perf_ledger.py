@@ -94,6 +94,12 @@ def _scenario_speedups(extra: dict) -> Dict[str, Any]:
             entry["degrade_ratio"] = res["degrade_ratio"]
         if isinstance(res.get("mesh_ladder"), str):
             entry["mesh_ladder"] = res["mesh_ladder"]
+        # adversarial-flush column (ISSUE 20): vote-path p99 under a 1%
+        # signature-poisoning flood as a multiple of the clean baseline
+        if isinstance(res.get("p99_ratio_1pct"), (int, float)):
+            entry["p99_ratio_1pct"] = res["p99_ratio_1pct"]
+        if "quarantine_isolated" in res:
+            entry["quarantine_isolated"] = bool(res["quarantine_isolated"])
         if isinstance(res.get("sigs_per_sec"), (int, float)):
             entry["sigs_per_sec"] = res["sigs_per_sec"]
         if res.get("degraded"):
@@ -126,6 +132,7 @@ def parse_bench(path: str) -> dict:
         "fleet_gate": None,
         "fleet_gate_missing": True,
         "mesh_degrade": None,
+        "poison_defense": None,
     }
     if doc is None or "_load_error" in (doc or {}):
         row["lost"] = True
@@ -184,6 +191,24 @@ def parse_bench(path: str) -> dict:
             "ladder": mf.get("mesh_ladder"),
             "rebuild_s": mf.get("rebuild_s"),
             "lost_verdicts": (mf.get("during") or {}).get("lost_verdicts"),
+        }
+    # quarantine/recovery column (ISSUE 20): rounds that ran the
+    # `poisoned_flush` scenario carry the vote-path p99 ratio under a 1%
+    # signature-poisoning flood, the bisection-vs-naive recovery speedup,
+    # the recovery-flush count at 1%, and whether the quarantine lane
+    # isolated exactly the poisoner; rounds that didn't show "—"
+    pf = extra.get("poisoned_flush")
+    if isinstance(pf, dict) and (
+        pf.get("p99_ratio_1pct") is not None
+        or pf.get("quarantine_isolated") is not None
+    ):
+        one_pct = (pf.get("rates") or {}).get("0.01") or {}
+        row["poison_defense"] = {
+            "p99_ratio_1pct": pf.get("p99_ratio_1pct"),
+            "speedup": pf.get("speedup"),
+            "recovery_flushes_1pct": one_pct.get("recovery_flushes"),
+            "quarantined_rows_1pct": one_pct.get("quarantined_rows"),
+            "quarantine_isolated": pf.get("quarantine_isolated"),
         }
     # a parsed round that carries NEITHER the headline metric nor a
     # headline scenario datapoint lost the trajectory point — flag it
@@ -308,6 +333,26 @@ def check_regressions(ledger: dict, tolerance: float = 0.25) -> List[str]:
                 f"verdict={fg.get('verdict')} heights={fg.get('heights')} "
                 f"violations={fg.get('violations')}"
             )
+    # poison defense (ISSUE 20): the newest round that ran the poisoned
+    # flood must keep vote-path p99 within 2x of the clean baseline AND
+    # the quarantine lane must have isolated exactly the poisoner
+    ran_poison = [r for r in ledger["bench"] if r.get("poison_defense")]
+    if ran_poison:
+        latest_pd = ran_poison[-1]
+        pd = latest_pd["poison_defense"]
+        ratio = pd.get("p99_ratio_1pct")
+        if isinstance(ratio, (int, float)) and ratio > 2.0:
+            failures.append(
+                f"poison defense failed in {latest_pd['file']}: vote-path "
+                f"p99 under 1% poison flood is {ratio:.2f}x the clean "
+                f"baseline (budget 2.00x)"
+            )
+        if pd.get("quarantine_isolated") is False:
+            failures.append(
+                f"poison defense failed in {latest_pd['file']}: quarantine "
+                f"lane did not isolate the poisoner "
+                f"(quarantine_isolated=false)"
+            )
     return failures
 
 
@@ -332,8 +377,8 @@ def render_markdown(ledger: dict) -> str:
         "",
         "## Bench rounds",
         "",
-        "| round | metric | value | speedup | prep hidden | fleet gate | mesh degrade | host | status |",
-        "|---:|---|---:|---:|---:|---|---|---|---|",
+        "| round | metric | value | speedup | prep hidden | fleet gate | mesh degrade | poison defense | host | status |",
+        "|---:|---|---:|---:|---:|---|---|---|---|---|",
     ]
     for r in ledger["bench"]:
         if r["lost"]:
@@ -378,6 +423,19 @@ def render_markdown(ledger: dict) -> str:
                 mesh += f"·**{lost} lost**"
         else:
             mesh = "—"
+        pd = r.get("poison_defense")
+        if pd:
+            ratio = pd.get("p99_ratio_1pct")
+            poison = (
+                f"{ratio:.2f}×" if isinstance(ratio, (int, float)) else "?"
+            )
+            rec = pd.get("recovery_flushes_1pct")
+            if rec is not None:
+                poison += f"·{rec}rf"
+            if pd.get("quarantine_isolated") is False:
+                poison += "·**LEAK**"  # quarantine missed the poisoner — BUG
+        else:
+            poison = "—"
         host = r["fingerprint"] or "—"
         if r.get("versions"):
             host += f" ({_fmt_versions(r['versions'])})"
@@ -388,7 +446,8 @@ def render_markdown(ledger: dict) -> str:
         )
         lines.append(
             f"| {_round_label(r)} | {r['metric'] or '—'} | {value} "
-            f"| {speed} | {hidden} | {fleet} | {mesh} | {host} | {status} |"
+            f"| {speed} | {hidden} | {fleet} | {mesh} | {poison} "
+            f"| {host} | {status} |"
         )
     lines += ["", "### Per-scenario speedups", ""]
     scen_names: List[str] = []

@@ -79,7 +79,8 @@ class VoteSet:
         self._maj23: Optional[BlockID] = None
         self._votes_by_block: Dict[bytes, _BlockVotes] = {}
         self._peer_maj23s: Dict[str, BlockID] = {}
-        self._pending: List[Tuple[int, Vote]] = []  # deferred-verification queue
+        # deferred-verification queue: (idx, vote, validator, peer_id)
+        self._pending: List[tuple] = []
         self._pending_seen: Set[Tuple[int, bytes, bytes]] = set()
         self._conflicts: List[ConflictingVotesError] = []
 
@@ -143,13 +144,19 @@ class VoteSet:
             return bv.get_by_index(idx)
         return None
 
-    def add_vote(self, vote: Vote):
+    def add_vote(self, vote: Vote, peer_id: str = ""):
         """Returns a truthy value if the vote was newly accepted: True when
         verified-and-committed, the string "pending" when queued for
         deferred batch verification (NOT yet verified — callers must not
         gossip/advertise it until flush() commits it). Raises VoteSetError
         on invalid votes and ConflictingVotesError on equivocation
-        (reference: types/vote_set.go:143-290)."""
+        (reference: types/vote_set.go:143-290).
+
+        peer_id: the gossiping peer, when known — deferred votes carry it
+        as row provenance (crypto/provenance.py "peer:<id>" tags) so a
+        peer whose votes fail batch verification gets quarantined and
+        punished instead of poisoning every later vote flush; "" means a
+        locally originated/replayed vote."""
         if vote is None:
             raise VoteSetError("nil vote")
         idx = vote.validator_index
@@ -185,8 +192,8 @@ class VoteSet:
                 return False
             self._pending_seen.add(seen_key)
             # carry the resolved Validator so flush() skips a second
-            # get_by_index per vote
-            self._pending.append((idx, vote, val))
+            # get_by_index per vote, and the gossiping peer for provenance
+            self._pending.append((idx, vote, val, peer_id))
             return "pending"
 
         if not self._verify_now(vote, val.pub_key):
@@ -216,11 +223,12 @@ class VoteSet:
             return [], []
         from tendermint_tpu.types import canonical
 
-        pubkeys, sigs, key_types = [], [], []
-        for _idx, vote, val in self._pending:
+        pubkeys, sigs, key_types, sources = [], [], [], []
+        for _idx, vote, val, peer_id in self._pending:
             pubkeys.append(val.pub_key.bytes())
             sigs.append(vote.signature)
             key_types.append(val.pub_key.type_name())
+            sources.append(f"peer:{peer_id}" if peer_id else "lane:votes")
         # One batched sign-bytes pass (shared type/height/round/chain_id;
         # profiled: the per-vote builder was 72% of flush time).
         msgs = canonical.vote_sign_bytes_many(
@@ -228,7 +236,7 @@ class VoteSet:
             self.signed_msg_type,
             self.height,
             self.round,
-            ((vote.block_id, vote.timestamp_ns) for _, vote, _ in self._pending),
+            ((vote.block_id, vote.timestamp_ns) for _, vote, _, _ in self._pending),
         )
         # key_types matters: in a mixed validator set an sr25519 vote
         # verified under ed25519 rules always fails (marker bit forces
@@ -249,14 +257,16 @@ class VoteSet:
 
         sched = _scheduler.default_scheduler()
         if sched is not None:
-            mask = sched.verify_rows("votes", pubkeys, msgs, sigs, key_types)
+            mask = sched.verify_rows("votes", pubkeys, msgs, sigs, key_types,
+                                     sources)
         else:
-            mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types)
+            mask = verify_batch(pubkeys, msgs, sigs, key_types=key_types,
+                                sources=sources)
         if hs is not None:
             hs.add("verify", hotstats.perf_counter() - t0, n=len(pubkeys))
         committed = []
         failed = []
-        for ok, (idx, vote, val) in zip(mask, self._pending):
+        for ok, (idx, vote, val, _peer) in zip(mask, self._pending):
             if not ok:
                 failed.append(idx)
                 continue

@@ -136,8 +136,9 @@ def test_fleet_spec_rejects_sub_quorum_fleets():
 
 def _smoke_spec(seed=SEED):
     """7 nodes (the issue caps the tier-1 smoke at 8): 4 ed25519
-    validators, one resident full node, one blocksync joiner, one light
-    edge; two short benign-ish chaos episodes."""
+    validators (one seated as the signature poisoner), one resident full
+    node, one blocksync joiner, one light edge; two short benign-ish chaos
+    episodes plus at least one guaranteed sig_poison flood."""
     return FleetSpec.generate(
         seed,
         7,
@@ -146,6 +147,7 @@ def _smoke_spec(seed=SEED):
         joiner_frac=0.5,
         bls_validators=0,
         statesync_joiners=0,
+        poisoners=1,
         peer_degree=3,
         episodes=2,
         min_gap=0.5,
@@ -154,7 +156,7 @@ def _smoke_spec(seed=SEED):
         max_episode=1.5,
         start_delay=0.5,
         join_window=(2.0, 4.0),
-        chaos_kinds=("partition", "peer_stall"),
+        chaos_kinds=("partition", "peer_stall", "sig_poison"),
     )
 
 
@@ -166,6 +168,24 @@ def test_fleet_smoke_end_to_end(tmp_path):
     assert len(spec.validators) == 4
     assert len(spec.joiners) == 1
     assert len(spec.light_edges) == 1
+    # the spec seats exactly one poisoner and schedules its flood
+    poisoners = [ns for ns in spec.nodes if ns.poisoner]
+    assert len(poisoners) == 1 and poisoners[0].role == ROLE_VALIDATOR
+    assert any(ev.kind == "sig_poison" for ev in spec.schedule.events)
+    # the composer protects the poisoner like the anchor: its flood (and
+    # its quarantine) must stay observable for the whole soak
+    assert all(
+        ev.param_dict().get("target") != poisoners[0].index
+        for ev in spec.schedule.events
+        if ev.kind in ("crash", "restart")
+    )
+
+    # the suspicion scorer is process-global (like the verified-row memo):
+    # start this soak from a clean slate so the quarantine assertions below
+    # are about THIS seeded adversary, not an earlier test's leftovers
+    from tendermint_tpu.crypto import provenance as _prov
+
+    _prov.default_scorer().reset()
 
     res = asyncio.run(
         run_fleet_soak(spec, str(tmp_path), min_heights=6, deadline_s=240.0)
@@ -173,6 +193,16 @@ def test_fleet_smoke_end_to_end(tmp_path):
 
     assert res["verdict"] == "pass"
     assert res["safety_violations"] == 0
+
+    # adversarial flush defense: the poisoner's flood (precheck-passing,
+    # verify-failing votes) was absorbed with ZERO safety violations, and
+    # the scorer quarantined exactly the seeded adversary's peer tag
+    poisoner_id = res["poisoners"][poisoners[0].index]
+    assert poisoner_id
+    suspicion = res["suspicion"]
+    assert f"peer:{poisoner_id}" in suspicion["quarantined"]
+    # repeat offenses while quarantined fed the punishment pipeline
+    assert suspicion["punished"] >= 1
     assert res["heights"] >= 6
     assert res["live_nodes"] == 7
     assert res["chaos_applied"] >= len(spec.schedule)

@@ -1,0 +1,205 @@
+"""Row provenance + suspicion scoring (ISSUE 20 adversarial flush defense).
+
+Unit contract for crypto/provenance.py — the per-source state machine the
+scheduler's quarantine lane and the punish pipeline (p2p trust scorer,
+mempool sender quota) hang off:
+
+- fill_sources normalization (None / short / empty entries -> lane tag);
+- quarantine at fail_quarantine failed rows, for ATTRIBUTABLE prefixes
+  only (an anonymous lane: tag must never reroute a whole lane);
+- clean rows decay the fail count (honest bit-flips never accumulate
+  into a quarantine);
+- clean-streak parole resets the episode;
+- punish callbacks fire ONCE per quarantine episode after punish_fails
+  offenses while quarantined; removal unhooks a stopped node;
+- LRU eviction is bounded and never evicts a quarantined source while a
+  non-quarantined victim exists (no laundering via fresh-id floods);
+- the sig_poison chaos kind: deterministic generation, JSON round-trip,
+  adversary level, well-formed params (chaos/schedule.py).
+"""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.chaos.schedule import ChaosSchedule, FaultEvent
+from tendermint_tpu.crypto.provenance import (
+    SuspicionScorer,
+    default_scorer,
+    fill_sources,
+    set_default,
+)
+
+SEED = 20260807
+
+
+def _feed(scorer, source, *, bad=0, clean=0):
+    """One flush's worth of rows from a single source."""
+    mask = np.array([False] * bad + [True] * clean, dtype=bool)
+    scorer.record_rows([source] * len(mask), mask)
+
+
+# ---------------------------------------------------------------------------
+# fill_sources
+
+
+def test_fill_sources_normalization():
+    assert fill_sources(None, 3, "votes") == ["lane:votes"] * 3
+    assert fill_sources(["peer:a", "", None], 3, "votes") == [
+        "peer:a",
+        "lane:votes",
+        "lane:votes",
+    ]
+    # short lists pad, long lists truncate — always exactly n tags
+    assert fill_sources(["peer:a"], 3, "light") == [
+        "peer:a",
+        "lane:light",
+        "lane:light",
+    ]
+    assert fill_sources(["peer:a", "peer:b"], 1, "votes") == ["peer:a"]
+
+
+# ---------------------------------------------------------------------------
+# quarantine / parole / punish
+
+
+def test_quarantine_at_threshold_peer_and_sender():
+    s = SuspicionScorer(fail_quarantine=3)
+    for src in ("peer:mallory", "sender:eve"):
+        _feed(s, src, bad=2)
+        assert not s.is_quarantined(src)
+        _feed(s, src, bad=1)
+        assert s.is_quarantined(src)
+    assert s.quarantined_sources() == frozenset({"peer:mallory", "sender:eve"})
+    assert s.any_quarantined(["peer:honest", "sender:eve"])
+    assert not s.any_quarantined(["peer:honest"])
+
+
+def test_lane_tags_are_never_quarantined():
+    s = SuspicionScorer(fail_quarantine=3)
+    _feed(s, "lane:catchup", bad=50)
+    assert not s.is_quarantined("lane:catchup")
+    assert s.quarantined_sources() == frozenset()
+    # the failures still show in the worst-offender stats
+    worst = {w["source"]: w for w in s.stats()["worst"]}
+    assert worst["lane:catchup"]["fails"] == 50
+
+
+def test_clean_rows_decay_fails():
+    """An honest peer with occasional bit-flipped rows never accumulates
+    into a quarantine: each clean row pays one fail back."""
+    s = SuspicionScorer(fail_quarantine=3)
+    for _ in range(10):
+        _feed(s, "peer:honest", bad=1)
+        _feed(s, "peer:honest", clean=2)
+    assert not s.is_quarantined("peer:honest")
+
+
+def test_parole_after_clean_streak():
+    s = SuspicionScorer(fail_quarantine=3, parole_clean=8)
+    _feed(s, "peer:flaky", bad=3)
+    assert s.is_quarantined("peer:flaky")
+    _feed(s, "peer:flaky", clean=7)
+    assert s.is_quarantined("peer:flaky")  # streak not yet at the gate
+    _feed(s, "peer:flaky", clean=1)
+    assert not s.is_quarantined("peer:flaky")
+    assert s.stats()["paroles"] == 1
+    # a bad row mid-streak resets it: quarantine survives
+    _feed(s, "peer:flaky", bad=3)
+    _feed(s, "peer:flaky", clean=7)
+    _feed(s, "peer:flaky", bad=1)
+    _feed(s, "peer:flaky", clean=7)
+    assert s.is_quarantined("peer:flaky")
+
+
+def test_punish_fires_once_per_episode_and_unhooks():
+    s = SuspicionScorer(fail_quarantine=3, parole_clean=4, punish_fails=8)
+    hits = []
+    s.add_punish_callback(lambda src, info: hits.append((src, dict(info))))
+    _feed(s, "peer:mallory", bad=3)  # quarantined, offenses=0
+    _feed(s, "peer:mallory", bad=7)
+    assert hits == []  # 7 offenses: below the punish gate
+    _feed(s, "peer:mallory", bad=1)
+    assert len(hits) == 1
+    src, info = hits[0]
+    assert src == "peer:mallory" and info["offenses"] >= 8
+    _feed(s, "peer:mallory", bad=20)
+    assert len(hits) == 1  # once per episode, however hard it floods
+    assert s.stats()["punished"] == 1
+    # parole ends the episode; re-offending punishes again
+    _feed(s, "peer:mallory", clean=4)
+    assert not s.is_quarantined("peer:mallory")
+    _feed(s, "peer:mallory", bad=3)
+    _feed(s, "peer:mallory", bad=8)
+    assert len(hits) == 2
+    # unhook (node shutdown): no further callbacks, removal is idempotent
+    cb = s._callbacks[0]
+    s.remove_punish_callback(cb)
+    s.remove_punish_callback(cb)
+    _feed(s, "peer:mallory", clean=4)
+    _feed(s, "peer:mallory", bad=11)
+    assert len(hits) == 2
+
+
+def test_punish_callback_exception_never_breaks_recording():
+    s = SuspicionScorer(fail_quarantine=1, punish_fails=1)
+
+    def boom(src, info):
+        raise RuntimeError("punishment backend down")
+
+    s.add_punish_callback(boom)
+    _feed(s, "peer:x", bad=2)  # quarantine + punish in one flush window
+    _feed(s, "peer:x", bad=1)
+    assert s.is_quarantined("peer:x")
+
+
+# ---------------------------------------------------------------------------
+# LRU bound
+
+
+def test_lru_eviction_bounded_and_protects_quarantined():
+    s = SuspicionScorer(fail_quarantine=3, max_sources=8)
+    _feed(s, "peer:mallory", bad=3)
+    assert s.is_quarantined("peer:mallory")
+    # a flood of fabricated fresh ids must not launder the quarantine
+    for i in range(100):
+        _feed(s, f"peer:fresh{i}", clean=1)
+    assert s.stats()["sources"] <= 8
+    assert s.is_quarantined("peer:mallory")
+
+
+def test_default_scorer_swap_roundtrip():
+    scratch = SuspicionScorer()
+    prev = set_default(scratch)
+    try:
+        assert default_scorer() is scratch
+    finally:
+        set_default(prev)
+    assert default_scorer() is prev
+
+
+# ---------------------------------------------------------------------------
+# sig_poison chaos kind (chaos/schedule.py)
+
+
+def test_sig_poison_schedule_deterministic_roundtrip():
+    kw = dict(episodes=9, kinds=("sig_poison",))
+    s = ChaosSchedule.generate(SEED, 4, **kw)
+    assert s == ChaosSchedule.generate(SEED, 4, **kw)
+    assert s.fingerprint() == ChaosSchedule.generate(SEED, 4, **kw).fingerprint()
+    rt = ChaosSchedule.from_json(s.to_json())
+    assert rt == s and rt.fingerprint() == s.fingerprint()
+    assert len(s) > 0
+    for e in s:
+        assert e.kind == "sig_poison"
+        assert e.level == "adversary"
+        p = e.param_dict()
+        assert 0 <= p["target"] < 4
+        # the flood must clear the quarantine (3) AND punish (8) gates
+        assert p["count"] >= 12
+
+
+def test_sig_poison_event_make_validates():
+    e = FaultEvent.make(1.0, "sig_poison", target=2, count=15)
+    assert e.level == "adversary"
+    with pytest.raises(ValueError):
+        FaultEvent.make(1.0, "sig_poisoning")

@@ -216,3 +216,93 @@ def test_deferred_commit_verifies_through_memo():
     assert st["hits"] == 48        # the commit's full memo hit
     lf = _last_flush()
     assert lf["backend"] == "memo" and lf["memo_hits"] == 48
+
+
+# ---------------------------------------------------------------------------
+# memo x quarantine (ISSUE 20): the adversarial flush defense must not
+# change the memo's safety contract, and the memo must not blind the
+# suspicion scorer.
+
+
+@pytest.fixture
+def scratch_scorer():
+    from tendermint_tpu.crypto import provenance as prov
+
+    scorer = prov.SuspicionScorer(fail_quarantine=3, parole_clean=30)
+    prev = prov.set_default(scorer)
+    yield scorer
+    prov.set_default(prev)
+
+
+def test_quarantined_clean_rows_may_enter_memo(scratch_scorer):
+    """A quarantined source's rows that verify CLEAN are memo-eligible:
+    quarantine is a scheduling demotion (slow lane), not a verdict — the
+    memo caches verdicts, and a clean verdict is a clean verdict."""
+    memo = _memo_on()
+    pks, msgs, sigs = _signed(20, b"\x37")
+    srcs = ["peer:mallory"] * 20
+
+    # quarantine the source with a poisoned flush first
+    bad = list(sigs)
+    for i in (0, 1, 2):
+        bad[i] = bad[i][:32] + (1).to_bytes(32, "little")
+    mask = batch.verify_batch(pks, msgs, bad, sources=srcs)
+    assert mask.sum() == 17
+    assert scratch_scorer.is_quarantined("peer:mallory")
+
+    # the 17 clean rows were memoized; the 3 failed rows were NOT
+    assert len(memo) == 17
+    for i in (0, 1, 2):
+        d = memo.digest_rows([pks[i]], [msgs[i]], [bad[i]])[0]
+        assert d not in memo
+
+    # a fully-clean flush from the still-quarantined source memoizes too
+    assert batch.verify_batch(pks, msgs, sigs, sources=srcs).all()
+    assert len(memo) == 20
+
+
+def test_memo_hits_count_toward_parole(scratch_scorer):
+    """Memo-answered rows verified clean in an earlier flush still feed
+    the scorer: a quarantined source whose repeats resolve through the
+    memo must be able to earn parole, not be starved of clean credit."""
+    _memo_on()
+    pks, msgs, sigs = _signed(16, b"\x38")
+    srcs = ["peer:flaky"] * 16
+
+    bad = list(sigs)
+    for i in (0, 1, 2):
+        bad[i] = bad[i][:32] + (1).to_bytes(32, "little")
+    batch.verify_batch(pks, msgs, bad, sources=srcs)
+    assert scratch_scorer.is_quarantined("peer:flaky")
+
+    # first clean flush verifies for real (16 clean), the second resolves
+    # entirely through the memo — BOTH must advance the clean streak
+    assert batch.verify_batch(pks, msgs, sigs, sources=srcs).all()
+    assert scratch_scorer.is_quarantined("peer:flaky")  # 16 < 30
+    assert batch.verify_batch(pks, msgs, sigs, sources=srcs).all()
+    assert _last_flush()["backend"] == "memo"
+    assert not scratch_scorer.is_quarantined("peer:flaky")  # 32 >= 30: parole
+    assert scratch_scorer.stats()["paroles"] == 1
+
+
+def test_memo_never_launders_a_poisoned_row_across_sources(scratch_scorer):
+    """A poisoned row replayed by a DIFFERENT source still fails: the
+    memo keys on row bytes, failed rows are never inserted, so a replay
+    re-verifies, fails again, and indicts the replaying source too."""
+    memo = _memo_on()
+    pks, msgs, sigs = _signed(12, b"\x39")
+    bad = list(sigs)
+    bad[5] = bad[5][:32] + (1).to_bytes(32, "little")
+
+    mask = batch.verify_batch(pks, msgs, bad, sources=["peer:a"] * 12)
+    assert not mask[5] and len(memo) == 11
+
+    # peer:b replays JUST the poisoned row, over and over: every replay
+    # misses the memo, re-verifies, fails — and accumulates suspicion
+    # (clean-row decay never sees a clean row to forgive with)
+    for _ in range(3):
+        mask = batch.verify_batch(
+            [pks[5]], [msgs[5]], [bad[5]], sources=["peer:b"]
+        )
+        assert not mask[0]
+    assert scratch_scorer.is_quarantined("peer:b")
